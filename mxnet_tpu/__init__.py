@@ -1,0 +1,73 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet 1.x's
+capability surface (reference: shuo-ouyang/incubator-mxnet), built on
+JAX/XLA/Pallas instead of the reference's C++ engine + CUDA/oneDNN kernels.
+
+Canonical import: ``import mxnet_tpu as mx`` — then the reference's idioms
+work with a one-line context swap: ``mx.cpu()`` → ``mx.tpu()``.
+
+Layer map of this package vs the reference (SURVEY §1/§7.1):
+  base/context/config/engine      ← base.h, context.py, env vars, engine (N1)
+  ndarray/ + ops/                 ← NDArray (N3) + operator corpus (N7/N25)
+  autograd                        ← imperative recording/backward (N4)
+  symbol/ + cachedop (hybridize)  ← nnvm Symbol + CachedOp (N5/N6)
+  gluon/                          ← python/mxnet/gluon (P6-P10)
+  optimizer/metric/initializer/lr_scheduler  ← P12/P16/P21
+  kvstore/                        ← src/kvstore + ps-lite (N12-N17) → XLA collectives
+  parallel/                       ← NEW: mesh/sharding/ring-attention (TPU-first)
+  io/ + image + recordio          ← src/io + python io/image (N19/P14/P15)
+  profiler/runtime                ← N20/N22
+"""
+
+__version__ = "0.1.0"
+
+from .base import MXNetError  # noqa: F401
+from .context import (  # noqa: F401
+    Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus,
+)
+from . import engine  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+
+# stateful-RNG convenience: mx.random.seed + mx.random.uniform(...) etc.
+random.uniform = nd.random.uniform
+random.normal = nd.random.normal
+random.randn = lambda *shape, **kw: nd.random.normal(shape=shape, **kw)
+random.randint = nd.random.randint
+random.multinomial = nd.random.multinomial
+random.shuffle = nd.shuffle
+
+
+def waitall():
+    nd.waitall()
+
+
+def _lazy(name):
+    import importlib
+    return importlib.import_module(f".{name}", __name__)
+
+
+def __getattr__(name):
+    # lazy submodule loading keeps `import mxnet_tpu` fast and breaks cycles
+    lazies = {"gluon", "optimizer", "metric", "initializer", "lr_scheduler",
+              "io", "image", "kvstore", "profiler", "runtime", "symbol",
+              "parallel", "test_utils", "recordio", "callback", "model",
+              "util", "numpy", "numpy_extension", "contrib", "models"}
+    if name in lazies:
+        mod = _lazy(name)
+        globals()[name] = mod
+        return mod
+    if name == "np":
+        mod = _lazy("numpy")
+        globals()["np"] = mod
+        return mod
+    if name == "npx":
+        mod = _lazy("numpy_extension")
+        globals()["npx"] = mod
+        return mod
+    if name == "kv":
+        mod = _lazy("kvstore")
+        globals()["kv"] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

@@ -1,0 +1,118 @@
+"""Base utilities: error types, dtype tables, handle plumbing.
+
+TPU-native rebuild of the role played by the reference's ``python/mxnet/base.py``
+(ctypes ``_LIB`` loading, ``check_call``, ``MXNetError``) and parts of
+``include/mxnet/base.h``.  There is no C ABI here — the "backend" is JAX/XLA —
+so this module keeps only the *semantic* surface: the error type every API
+raises, the canonical dtype table (MXNet type-flag integers preserved for
+``.params`` serialization compat), and small shared helpers.
+
+Reference anchors: python/mxnet/base.py :: MXNetError, _LIB, check_call;
+include/mxnet/base.h :: Context (dev type enums).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError",
+    "NotSupportedForSparseNDArray",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "DTYPE_ID_TO_NP",
+    "NP_TO_DTYPE_ID",
+    "mx_real_t",
+    "mx_uint",
+    "check_call",
+]
+
+
+class MXNetError(RuntimeError):
+    """Default error type for all mxnet_tpu API failures.
+
+    The reference surfaces C++ ``dmlc::Error`` through ``MXGetLastError`` and
+    re-raises it as ``MXNetError``; here errors originate in Python/JAX but the
+    public type is preserved so user ``except MXNetError`` code keeps working.
+    """
+
+
+class NotSupportedForSparseNDArray(MXNetError):
+    def __init__(self, function, alias, *args):
+        argstr = ", ".join(str(a) for a in args)
+        super().__init__(
+            f"Function {getattr(function, '__name__', function)} "
+            f"(alias {alias}) with arguments ({argstr}) is not supported for SparseNDArray"
+        )
+
+
+string_types = (str,)
+integer_types = (int, _np.integer)
+numeric_types = (float, int, _np.generic)
+
+# MXNet dtype type-flag table (src/common/utils.h / mshadow type switch order).
+# Preserved verbatim so the `.params` binary format round-trips with reference
+# checkpoints.  bfloat16 uses the 1.x extension slot (12) used by AMP-era forks.
+DTYPE_ID_TO_NP = {
+    0: _np.float32,
+    1: _np.float64,
+    2: _np.float16,
+    3: _np.uint8,
+    4: _np.int32,
+    5: _np.int8,
+    6: _np.int64,
+    7: _np.bool_,
+    8: _np.int16,
+    9: _np.uint16,
+    10: _np.uint32,
+    11: _np.uint64,
+    12: "bfloat16",  # resolved lazily against ml_dtypes below
+}
+
+try:  # bfloat16 numpy dtype ships with jax via ml_dtypes
+    import ml_dtypes as _ml_dtypes
+
+    bfloat16 = _np.dtype(_ml_dtypes.bfloat16)
+    DTYPE_ID_TO_NP[12] = bfloat16
+except ImportError:  # pragma: no cover - ml_dtypes is a jax hard dep
+    bfloat16 = None
+
+NP_TO_DTYPE_ID = {}
+for _k, _v in DTYPE_ID_TO_NP.items():
+    try:
+        NP_TO_DTYPE_ID[_np.dtype(_v)] = _k
+    except TypeError:
+        pass
+
+mx_real_t = _np.float32
+mx_uint = _np.uint32
+
+
+def check_call(ret):
+    """Compatibility shim for reference-style ``check_call(_LIB.MX...)`` code.
+
+    In the reference every C-ABI call returns an int status checked here.  We
+    keep the function so mechanical call sites survive, but the only accepted
+    value is 0/None (success).
+    """
+    if ret:  # non-zero status
+        raise MXNetError(f"backend call failed with status {ret}")
+
+
+def dtype_from_any(dtype):
+    """Normalize str/np.dtype/type-flag int into a numpy dtype."""
+    if dtype is None:
+        return _np.dtype(mx_real_t)
+    if isinstance(dtype, int) and not isinstance(dtype, bool):
+        if dtype not in DTYPE_ID_TO_NP:
+            raise MXNetError(f"unknown dtype type-flag {dtype}")
+        return _np.dtype(DTYPE_ID_TO_NP[dtype])
+    return _np.dtype(dtype)
+
+
+def dtype_to_id(dtype):
+    d = _np.dtype(dtype)
+    if d not in NP_TO_DTYPE_ID:
+        raise MXNetError(f"dtype {d} has no MXNet type-flag (not serializable)")
+    return NP_TO_DTYPE_ID[d]

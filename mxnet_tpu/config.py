@@ -1,0 +1,112 @@
+"""Single catalog of environment-variable configuration.
+
+The reference reads ~100 ``MXNET_*`` env vars ad-hoc via ``dmlc::GetEnv`` at
+point of use (SURVEY §5.6; canonical catalog in the reference's
+docs/static_site/src/pages/api/faq/env_var.md).  This rebuild centralizes every
+knob here: one typed accessor, one place to document, introspectable via
+``mxnet_tpu.runtime``.
+
+Only knobs that are meaningful on the TPU/XLA stack are kept; reference knobs
+that are absorbed by XLA (e.g. MXNET_GPU_WORKER_NTHREADS, memory-pool tuning)
+are accepted but ignored, so existing launch scripts don't break.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["get", "get_bool", "get_int", "get_float", "describe", "KNOWN_VARS"]
+
+# name -> (default, type, help)
+KNOWN_VARS = {
+    # engine family (reference: src/engine/engine.cc :: CreateEngine)
+    "MXNET_ENGINE_TYPE": (
+        "ThreadedEnginePerDevice",
+        str,
+        "Execution engine. 'NaiveEngine' blocks after every op (serialized, "
+        "deterministic debugging — reference semantics); anything else keeps "
+        "JAX/XLA async dispatch.",
+    ),
+    "MXNET_EXEC_BULK_EXEC_TRAIN": (
+        "1", str, "Accepted for compat; XLA fuses/bulk-schedules automatically."),
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": ("1", str, "Accepted for compat; no-op."),
+    # memory family — absorbed by XLA/PJRT allocator
+    "MXNET_GPU_MEM_POOL_TYPE": ("Round", str, "Accepted for compat; no-op on TPU."),
+    "MXNET_GPU_MEM_POOL_RESERVE": ("5", str, "Accepted for compat; no-op on TPU."),
+    # kvstore family
+    "MXNET_KVSTORE_REDUCTION_NTHREADS": ("4", int, "Compat; reductions run on-device."),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": (
+        str(1000 * 1000), int,
+        "Arrays larger than this (elements) may use reduce_scatter+all_gather "
+        "instead of one psum in dist kvstore."),
+    "MXNET_KVSTORE_USETREE": ("0", str, "Compat; ICI topology handled by XLA."),
+    # profiler
+    "MXNET_PROFILER_AUTOSTART": ("0", int, "Start the profiler at import."),
+    "MXNET_PROFILER_MODE": ("0", int, "Compat flag for storage profiling."),
+    # data pipeline
+    "MXNET_CPU_WORKER_NTHREADS": ("1", int, "Worker threads for host-side data aug."),
+    # testing / RNG (reference: tests/python/unittest/common.py)
+    "MXNET_TEST_SEED": (None, int, "Per-test RNG seed override."),
+    "MXNET_MODULE_SEED": (None, int, "Module-wide RNG seed override."),
+    # TPU-rebuild-specific
+    "MXNET_TPU_DEFAULT_MATMUL_PRECISION": (
+        "default", str,
+        "jax.lax matmul precision for float32 ops: default|high|highest."),
+    "MXNET_TPU_JIT_IMPERATIVE": (
+        "1", int,
+        "If 1, imperative op dispatch goes through a per-(op,shape,dtype,attrs) "
+        "jax.jit cache; if 0, ops run op-by-op eagerly."),
+    "MXNET_SHOW_ENV": ("0", int, "Print the env-var catalog at import (1.7 parity)."),
+}
+
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+def get(name, default=None):
+    """String value of an env var, with catalog defaults."""
+    if name in os.environ:
+        return os.environ[name]
+    if name in KNOWN_VARS:
+        d = KNOWN_VARS[name][0]
+        return d if d is not None else default
+    return default
+
+
+def _typed(name, default, caster):
+    v = get(name)
+    if v is None:
+        return default
+    try:
+        return caster(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def get_int(name, default=0):
+    return _typed(name, default, int)
+
+
+def get_float(name, default=0.0):
+    return _typed(name, default, float)
+
+
+def get_bool(name, default=False):
+    v = get(name)
+    if v is None:
+        return default
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+def describe():
+    """Return the full catalog as rows (name, current, default, help)."""
+    rows = []
+    for name, (default, _typ, doc) in sorted(KNOWN_VARS.items()):
+        rows.append((name, get(name), default, doc))
+    return rows
+
+
+if get_bool("MXNET_SHOW_ENV"):
+    for _row in describe():
+        print("%-40s = %-24s # %s" % (_row[0], _row[1], _row[3]))

@@ -1,0 +1,82 @@
+"""Execution-engine facade: async-dispatch contract over JAX.
+
+The reference's heart is a C++ async dependency engine
+(src/engine/threaded_engine.cc :: ThreadedEngine — per-var read/write queues,
+per-device worker threads; SURVEY §1 L2/N1).  On TPU, JAX's async dispatch +
+XLA *is* that engine: every op returns immediately with a future-backed
+``jax.Array`` and the runtime orders execution by data dependence.  What this
+module keeps is the reference's *contract*:
+
+ - ``MXNET_ENGINE_TYPE=NaiveEngine`` ⇒ fully serialized execution (block after
+   every op) — the determinism/debugging escape hatch the reference tests use.
+ - ``waitall()`` — barrier until every outstanding computation retires.
+ - async errors surface at the next sync point (``wait_to_read``/``asnumpy``),
+   matching the reference's tests/python/unittest/test_exc_handling.py
+   contract; JAX gives this natively on TPU, and NaiveEngine makes them
+   synchronous exactly like the reference.
+ - ``bulk()`` scope (python/mxnet/engine.py parity) — a no-op context manager:
+   XLA fuses/bulks automatically.
+
+There are no worker threads, var queues, or FnProperty priority lanes to
+rebuild: those exist to overlap compute/copy/comm on CUDA streams, which
+XLA:TPU schedules itself.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from . import config
+
+__all__ = ["is_naive", "set_engine_type", "on_dispatch", "waitall", "bulk",
+           "set_bulk_size"]
+
+_engine_type = None
+
+
+def _current_type():
+    global _engine_type
+    if _engine_type is None:
+        _engine_type = config.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+    return _engine_type
+
+
+def set_engine_type(name):
+    """Runtime override of MXNET_ENGINE_TYPE (reference allows env only)."""
+    global _engine_type
+    _engine_type = name
+
+
+def is_naive():
+    return _current_type() == "NaiveEngine"
+
+
+def on_dispatch(arrays):
+    """Called by the op dispatcher with every batch of freshly produced
+    jax.Arrays.  In NaiveEngine mode this blocks — serializing execution and
+    making errors synchronous, the reference's NaiveEngine semantics."""
+    if is_naive():
+        import jax
+        from jax.core import Tracer
+        concrete = [a for a in arrays if not isinstance(a, Tracer)]
+        if concrete:
+            jax.block_until_ready(concrete)
+
+
+def waitall():
+    """Engine::WaitForAll — block until all live computations retire."""
+    import jax
+    arrs = [a for a in jax.live_arrays() if not a.is_deleted()]
+    if arrs:
+        jax.block_until_ready(arrs)
+
+
+@contextlib.contextmanager
+def bulk(size):  # noqa: ARG001 - size accepted for API parity
+    """python/mxnet/engine.py :: bulk — XLA bulks automatically; no-op scope."""
+    yield
+
+
+def set_bulk_size(size):
+    """Reference returns the previous bulk size; bulking is XLA's job now."""
+    return size

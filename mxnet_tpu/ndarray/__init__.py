@@ -1,0 +1,21 @@
+"""mx.nd — the imperative NDArray API (reference python/mxnet/ndarray/)."""
+
+from .ndarray import (  # noqa: F401
+    NDArray, array, zeros, ones, full, empty, arange, concat, save, load,
+    waitall, from_numpy, from_dlpack,
+)
+
+import sys as _sys
+
+from . import register as _register
+
+# generate mx.nd.<op> namespaces from the registry (reference parity:
+# python/mxnet/ndarray/register.py runs at import)
+_GENERATED = _register.populate(_sys.modules[__name__])
+
+from . import sparse  # noqa: F401,E402
+
+
+def imresize(*args, **kwargs):
+    from ..image import imresize as _f
+    return _f(*args, **kwargs)

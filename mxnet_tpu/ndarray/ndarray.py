@@ -1,0 +1,824 @@
+"""NDArray: MXNet's mutable async tensor, rebuilt over immutable jax.Arrays.
+
+Reference anchors (SURVEY §2 N3, §7.1): include/mxnet/ndarray.h :: class
+NDArray — ref-counted Chunk (storage + engine var), views (Slice/Reshape/At),
+WaitToRead/WaitToWrite, Save/Load, autograd entry hooks;
+python/mxnet/ndarray/ndarray.py — the Python surface.
+
+TPU-native design — the **versioned slot**:
+ - an NDArray owns a ``_Slot`` holding one immutable ``jax.Array`` plus a
+   version counter.  "In-place" operations (``a[:]=``, ``+=``, optimizer
+   updates, ``kv.pull(out=)``) swap the slot's array for a new functional
+   value and bump the version.  Read-after-write ordering across aliases is
+   then by construction: every read resolves the slot at call time, and JAX's
+   async dispatch (the engine, see mxnet_tpu.engine) orders device work by
+   data dependence.
+ - **views** (basic-index slices, reshape) carry ``(base, spec)`` instead of
+   data; reads re-slice the base's current value lazily, writes write back
+   through the chain with ``x.at[idx].set`` — no index composition needed, and
+   aliasing stays exact through arbitrarily nested views.
+ - under ``autograd.record()``, slicing returns a *recorded copy* instead of a
+   view (functional semantics on the tape) and in-place writes to arrays that
+   participate in grad raise — the reference imposes the same restriction on
+   recorded arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError, dtype_from_any, mx_real_t
+from ..context import Context, current_context
+from .. import engine as _engine
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concat", "save", "load", "waitall", "from_numpy", "from_dlpack"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+class _Slot:
+    __slots__ = ("value", "version")
+
+    def __init__(self, value):
+        self.value = value
+        self.version = 0
+
+
+def _ctx_of_array(arr):
+    try:
+        dev = arr.device
+        if dev is None:
+            return current_context()
+        if dev.platform == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", dev.id)
+    except Exception:
+        return current_context()
+
+
+_BASIC_TYPES = (int, slice, type(None), type(Ellipsis))
+
+
+def _is_basic_index(key):
+    if isinstance(key, _BASIC_TYPES):
+        return True
+    if isinstance(key, tuple):
+        return all(isinstance(k, _BASIC_TYPES) for k in key)
+    return False
+
+
+class NDArray:
+    __slots__ = ("_slot", "_base", "_view", "_shape_cache", "_node", "_grad",
+                 "grad_req", "_grad_epoch", "_ctx", "__weakref__")
+    __array_priority__ = 1000.0
+
+    # -- construction ---------------------------------------------------------
+    def __init__(self):
+        self._slot = None
+        self._base = None
+        self._view = None
+        self._shape_cache = None
+        self._node = None
+        self._grad = None
+        self.grad_req = "null"
+        self._grad_epoch = -1
+        self._ctx = None
+
+    @classmethod
+    def _from_data(cls, arr, ctx=None):
+        self = cls()
+        self._slot = _Slot(arr)
+        self._ctx = ctx if ctx is not None else _ctx_of_array(arr)
+        return self
+
+    @classmethod
+    def _make_view(cls, base, view_spec, shape):
+        self = cls()
+        self._base = base
+        self._view = view_spec
+        self._shape_cache = shape
+        self._ctx = base._ctx
+        return self
+
+    # -- data access (the versioned-slot read/write protocol) -----------------
+    @property
+    def _data(self):
+        if self._base is None:
+            return self._slot.value
+        kind, spec = self._view
+        bv = self._base._data
+        if kind == "index":
+            return bv[spec]
+        return bv.reshape(spec)  # kind == "reshape"
+
+    def _set_data(self, arr):
+        """Full overwrite of this array's (or view region's) value."""
+        if self._base is None:
+            self._slot.value = arr
+            self._slot.version += 1
+            return
+        kind, spec = self._view
+        if kind == "index":
+            self._base._update_region(spec, arr)
+        else:  # reshape view: push the whole buffer back through
+            self._base._set_data(arr.reshape(self._base.shape))
+
+    def _update_region(self, idx, value):
+        if self._base is None:
+            self._slot.value = self._slot.value.at[idx].set(value)
+            self._slot.version += 1
+        else:
+            cur = self._data
+            self._set_data(cur.at[idx].set(value))
+
+    def _check_writable(self):
+        from .. import autograd
+        if autograd.is_recording() and (self._node is not None
+                                        or (self._base is not None
+                                            and self._base._node is not None)):
+            raise MXNetError(
+                "in-place write to an array that is part of a recorded "
+                "computation is not allowed inside autograd.record() "
+                "(reference contract: mutating recorded arrays invalidates "
+                "the tape)")
+
+    # -- basic properties -----------------------------------------------------
+    @property
+    def shape(self):
+        if self._base is None:
+            return tuple(self._slot.value.shape)
+        return self._shape_cache
+
+    @property
+    def dtype(self):
+        if self._base is None:
+            return _np.dtype(self._slot.value.dtype)
+        return self._base.dtype
+
+    @property
+    def size(self):
+        s = 1
+        for d in self.shape:
+            s *= d
+        return s
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def ctx(self):
+        return self._ctx
+
+    context = ctx
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def handle(self):
+        return self  # ABI-handle parity shim
+
+    # -- sync points ----------------------------------------------------------
+    def wait_to_read(self):
+        import jax
+        jax.block_until_ready(self._data)
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- autograd -------------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):  # noqa: ARG002
+        self._node = None  # attach_grad detaches (reference semantics)
+        self.grad_req = grad_req
+        self._grad = zeros(self.shape, dtype=self.dtype, ctx=self.ctx)
+
+    def _accumulate_grad(self, g):
+        from .. import autograd
+        if self._grad is None or self.grad_req == "null":
+            return
+        ep = autograd._current_epoch()
+        if autograd._st().create_graph_mode and isinstance(g, NDArray):
+            # higher-order mode: the grad must carry its tape node, so the
+            # buffer object itself is replaced (documented divergence: the
+            # old ._grad buffer is not aliased in this mode)
+            if self.grad_req == "write" and self._grad_epoch != ep:
+                self._grad = g
+            else:
+                self._grad = self._grad + g
+            self._grad_epoch = ep
+            return
+        if isinstance(g, NDArray):
+            g = g._data
+        if self.grad_req == "write" and self._grad_epoch != ep:
+            self._grad._set_data(g)
+        else:
+            self._grad._set_data(self._grad._data + g)
+        self._grad_epoch = ep
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+        autograd.backward([self], [out_grad], retain_graph=retain_graph,
+                          train_mode=train_mode)
+
+    def detach(self):
+        out = NDArray._from_data(self._data, ctx=self.ctx)
+        return out
+
+    # -- device movement ------------------------------------------------------
+    def as_in_context(self, ctx):
+        if ctx == self.ctx:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+
+    def copyto(self, other):
+        import jax
+        if isinstance(other, NDArray):
+            arr = jax.device_put(self._data, other.ctx.jax_device())
+            other._set_data(arr)
+            return other
+        if isinstance(other, Context):
+            arr = jax.device_put(self._data, other.jax_device())
+            return NDArray._from_data(arr, ctx=Context(other))
+        raise MXNetError(f"copyto does not support type {type(other)}")
+
+    def copy(self):
+        return NDArray._from_data(self._data, ctx=self.ctx)
+
+    def astype(self, dtype, copy=True):
+        dt = dtype_from_any(dtype)
+        if not copy and dt == self.dtype:
+            return self
+        return self._op1("cast", dtype=dt)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+        return _sp.cast_storage(self, stype)
+
+    # -- op dispatch sugar ----------------------------------------------------
+    def _op1(self, opname, **attrs):
+        from ..ops import registry as _reg
+        return _reg.invoke(_reg.get(opname), [self], attrs)
+
+    def _op2(self, opname, other, scalar_op=None, reverse=False, **attrs):
+        from ..ops import registry as _reg
+        if isinstance(other, NDArray):
+            ins = [other, self] if reverse else [self, other]
+            return _reg.invoke(_reg.get(opname), ins, attrs)
+        if isinstance(other, (int, float, bool, _np.generic)):
+            a = dict(attrs)
+            a["scalar"] = float(other)
+            a["reverse"] = reverse
+            return _reg.invoke(_reg.get(scalar_op or opname + "_scalar"),
+                               [self], a)
+        return NotImplemented
+
+    # arithmetic — names match the reference's broadcast_* op family
+    def __add__(self, o):
+        return self._op2("broadcast_add", o, "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._op2("broadcast_sub", o, "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._op2("broadcast_sub", o, "_minus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._op2("broadcast_mul", o, "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._op2("broadcast_div", o, "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._op2("broadcast_div", o, "_div_scalar", reverse=True)
+
+    def __floordiv__(self, o):
+        return self._op2("broadcast_floor_div", o, "_floor_div_scalar")
+
+    def __rfloordiv__(self, o):
+        return self._op2("broadcast_floor_div", o, "_floor_div_scalar", reverse=True)
+
+    def __mod__(self, o):
+        return self._op2("broadcast_mod", o, "_mod_scalar")
+
+    def __rmod__(self, o):
+        return self._op2("broadcast_mod", o, "_mod_scalar", reverse=True)
+
+    def __pow__(self, o):
+        return self._op2("broadcast_power", o, "_power_scalar")
+
+    def __rpow__(self, o):
+        return self._op2("broadcast_power", o, "_power_scalar", reverse=True)
+
+    def __matmul__(self, o):
+        return self._op2("matmul", o)
+
+    def __neg__(self):
+        return self._op1("negative")
+
+    def __abs__(self):
+        return self._op1("abs")
+
+    # comparisons
+    def __eq__(self, o):
+        if o is None:
+            return False
+        r = self._op2("broadcast_equal", o, "_equal_scalar")
+        return r
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._op2("broadcast_not_equal", o, "_not_equal_scalar")
+
+    def __lt__(self, o):
+        return self._op2("broadcast_lesser", o, "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._op2("broadcast_lesser_equal", o, "_lesser_equal_scalar")
+
+    def __gt__(self, o):
+        return self._op2("broadcast_greater", o, "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._op2("broadcast_greater_equal", o, "_greater_equal_scalar")
+
+    __hash__ = object.__hash__  # identity hash, reference parity
+
+    # in-place ops: under recording they rebind functionally (safe for tape);
+    # outside they mutate the slot (reference engine-ordered write).
+    def _iop(self, opname, scalar_op, other):
+        from .. import autograd
+        if autograd.is_recording():
+            return self._op2(opname, other, scalar_op)
+        res = self._op2(opname, other, scalar_op)
+        self._set_data(res._data)
+        return self
+
+    def __iadd__(self, o):
+        return self._iop("broadcast_add", "_plus_scalar", o)
+
+    def __isub__(self, o):
+        return self._iop("broadcast_sub", "_minus_scalar", o)
+
+    def __imul__(self, o):
+        return self._iop("broadcast_mul", "_mul_scalar", o)
+
+    def __itruediv__(self, o):
+        return self._iop("broadcast_div", "_div_scalar", o)
+
+    # -- indexing -------------------------------------------------------------
+    def __getitem__(self, key):
+        from .. import autograd
+        if isinstance(key, NDArray):
+            key = key._data
+        if _is_basic_index(key):
+            if autograd.is_recording():
+                return self._op1("_slice_basic", key=_freeze_index(key))
+            import jax
+            shape = jax.eval_shape(lambda x: x[key],
+                                   jax.ShapeDtypeStruct(self.shape, self.dtype)).shape
+            return NDArray._make_view(self, ("index", key), tuple(shape))
+        # advanced indexing → copy (reference semantics)
+        data = self._data[_np.asarray(key) if isinstance(key, list) else key]
+        return NDArray._from_data(data, ctx=self.ctx)
+
+    def __setitem__(self, key, value):
+        self._check_writable()
+        if isinstance(key, NDArray):
+            key = key._data
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, (_np.ndarray, list)):
+            value = _jnp().asarray(value, dtype=self.dtype)
+        if isinstance(key, slice) and key == slice(None) and not _np.isscalar(value):
+            v = _jnp().broadcast_to(_jnp().asarray(value, dtype=self.dtype), self.shape)
+            self._set_data(v)
+        else:
+            cur = self._data
+            if isinstance(key, list):
+                key = _np.asarray(key)
+            self._set_data(cur.at[key].set(value))
+        _engine.on_dispatch([self._data] if self._base is None else [])
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("The truth value of an NDArray with multiple elements "
+                         "is ambiguous")
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __repr__(self):
+        try:
+            data = str(self.asnumpy())
+        except Exception as e:  # async error surfaces here, like the reference
+            raise
+        return f"\n{data}\n<NDArray {'x'.join(map(str, self.shape))} @{self.ctx}>"
+
+    # -- shape manipulation (views) ------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        new_shape = _infer_reshape(self.shape, tuple(shape))
+        from .. import autograd
+        if autograd.is_recording():
+            return self._op1("reshape", shape=new_shape)
+        return NDArray._make_view(self, ("reshape", new_shape), new_shape)
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    @property
+    def T(self):
+        return self._op1("transpose")
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return self._op1("transpose", axes=axes if axes else None)
+
+    def swapaxes(self, dim1, dim2):
+        return self._op1("swapaxes", dim1=dim1, dim2=dim2)
+
+    def flatten(self):
+        return self.reshape((self.shape[0], -1) if self.ndim > 1 else (-1,))
+
+    def expand_dims(self, axis):
+        return self._op1("expand_dims", axis=axis)
+
+    def squeeze(self, axis=None):
+        return self._op1("squeeze", axis=axis)
+
+    def broadcast_to(self, shape):
+        return self._op1("broadcast_to", shape=tuple(shape))
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def slice(self, begin, end, step=None):
+        return self._op1("slice", begin=tuple(begin), end=tuple(end),
+                         step=tuple(step) if step else None)
+
+    def slice_axis(self, axis, begin, end):
+        return self._op1("slice_axis", axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        from ..ops import registry as _reg
+        return _reg.invoke(_reg.get("take"), [self, indices],
+                           {"axis": axis, "mode": mode})
+
+    def pick(self, index, axis=-1, keepdims=False):
+        from ..ops import registry as _reg
+        return _reg.invoke(_reg.get("pick"), [self, index],
+                           {"axis": axis, "keepdims": keepdims})
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return self._op1("one_hot", depth=depth, on_value=on_value,
+                         off_value=off_value)
+
+    # reductions / common math as methods (reference NDArray method surface)
+    def _reduce(self, opname, axis=None, keepdims=False, **kw):
+        return self._op1(opname, axis=_norm_axis(axis), keepdims=keepdims, **kw)
+
+    def sum(self, axis=None, keepdims=False):
+        return self._reduce("sum", axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._reduce("mean", axis, keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce("max", axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce("min", axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._reduce("prod", axis, keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return self._op1("norm", ord=ord, axis=_norm_axis(axis), keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return self._op1("argmax", axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return self._op1("argmin", axis=axis, keepdims=keepdims)
+
+    def abs(self):
+        return self._op1("abs")
+
+    def sqrt(self):
+        return self._op1("sqrt")
+
+    def square(self):
+        return self._op1("square")
+
+    def exp(self):
+        return self._op1("exp")
+
+    def log(self):
+        return self._op1("log")
+
+    def relu(self):
+        return self._op1("relu")
+
+    def sigmoid(self):
+        return self._op1("sigmoid")
+
+    def tanh(self):
+        return self._op1("tanh")
+
+    def softmax(self, axis=-1):
+        return self._op1("softmax", axis=axis)
+
+    def log_softmax(self, axis=-1):
+        return self._op1("log_softmax", axis=axis)
+
+    def clip(self, a_min, a_max):
+        return self._op1("clip", a_min=a_min, a_max=a_max)
+
+    def dot(self, other, **kw):
+        from ..ops import registry as _reg
+        return _reg.invoke(_reg.get("dot"), [self, other], kw)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return self._op1("topk", axis=axis, k=k, ret_typ=ret_typ,
+                         is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return self._op1("sort", axis=axis, is_ascend=is_ascend)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return self._op1("argsort", axis=axis, is_ascend=is_ascend)
+
+    def tile(self, reps):
+        return self._op1("tile", reps=tuple(reps) if not isinstance(reps, int) else (reps,))
+
+    def repeat(self, repeats, axis=None):
+        return self._op1("repeat", repeats=repeats, axis=axis)
+
+    def flip(self, axis):
+        return self._op1("flip", axis=axis)
+
+    def zeros_like(self):
+        return zeros(self.shape, dtype=self.dtype, ctx=self.ctx)
+
+    def ones_like(self):
+        return ones(self.shape, dtype=self.dtype, ctx=self.ctx)
+
+    def as_np_ndarray(self):
+        from .. import numpy as _mxnp
+        return _mxnp.ndarray._as_np(self)
+
+    def to_dlpack_for_read(self):
+        return self._data.__dlpack__()
+
+    to_dlpack_for_write = to_dlpack_for_read
+
+
+def _freeze_index(key):
+    """Make a basic index hashable for jit attr caching."""
+    def f(k):
+        if isinstance(k, slice):
+            return ("slice", k.start, k.stop, k.step)
+        if k is Ellipsis:
+            return ("ellipsis",)
+        if k is None:
+            return ("newaxis",)
+        return ("int", int(k))
+    if isinstance(key, tuple):
+        return ("tuple",) + tuple(f(k) for k in key)
+    return f(key)
+
+
+def _thaw_index(fk):
+    def g(t):
+        if t[0] == "slice":
+            return slice(t[1], t[2], t[3])
+        if t[0] == "ellipsis":
+            return Ellipsis
+        if t[0] == "newaxis":
+            return None
+        return t[1]
+    if fk[0] == "tuple":
+        return tuple(g(t) for t in fk[1:])
+    return g(fk)
+
+
+def _norm_axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+def _infer_reshape(old_shape, new_shape):
+    """MXNet reshape special codes: 0 copy-dim, -1 infer, -2 copy-rest,
+    -3 merge-two, -4 split (reference src/operator/tensor/matrix_op-inl.h)."""
+    if all(isinstance(d, int) and d > 0 for d in new_shape):
+        return tuple(new_shape)
+    out = []
+    src = list(old_shape)
+    i = 0  # index into old dims
+    j = 0
+    ns = list(new_shape)
+    while j < len(ns):
+        d = ns[j]
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            out.append(-1); i += 1
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            a, b = ns[j + 1], ns[j + 2]
+            cur = src[i]
+            if a == -1:
+                a = cur // b
+            if b == -1:
+                b = cur // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(d); i += 1
+        j += 1
+    # resolve single -1 by element count
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in old_shape:
+            total *= d
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+# --------------------------------------------------------------------------
+# creation
+# --------------------------------------------------------------------------
+
+def _put(np_arr, ctx):
+    import jax
+    ctx = ctx if ctx is not None else current_context()
+    return jax.device_put(np_arr, ctx.jax_device()), ctx
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        src = source_array.asnumpy()
+    else:
+        src = _np.asarray(source_array)
+    if dtype is None:
+        # reference default: python floats land as float32 (mx_real_t)
+        dtype = mx_real_t if src.dtype == _np.float64 else src.dtype
+    src = src.astype(dtype_from_any(dtype), copy=False)
+    arr, ctx = _put(src, ctx)
+    return NDArray._from_data(arr, ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):  # noqa: ARG001
+    if isinstance(shape, int):
+        shape = (shape,)
+    import jax
+    ctx = ctx if ctx is not None else current_context()
+    with jax.default_device(ctx.jax_device()):
+        arr = _jnp().zeros(tuple(shape), dtype_from_any(dtype))
+    return NDArray._from_data(arr, ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):  # noqa: ARG001
+    if isinstance(shape, int):
+        shape = (shape,)
+    import jax
+    ctx = ctx if ctx is not None else current_context()
+    with jax.default_device(ctx.jax_device()):
+        arr = _jnp().ones(tuple(shape), dtype_from_any(dtype))
+    return NDArray._from_data(arr, ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    import jax
+    ctx = ctx if ctx is not None else current_context()
+    with jax.default_device(ctx.jax_device()):
+        arr = _jnp().full(tuple(shape), val, dtype_from_any(dtype))
+    return NDArray._from_data(arr, ctx=ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    import jax
+    ctx = ctx if ctx is not None else current_context()
+    with jax.default_device(ctx.jax_device()):
+        arr = _jnp().arange(start, stop, step, dtype_from_any(dtype))
+        if repeat != 1:
+            arr = _jnp().repeat(arr, repeat)
+    return NDArray._from_data(arr, ctx=ctx)
+
+
+def concat(*arrays, dim=1):
+    from ..ops import registry as _reg
+    return _reg.invoke(_reg.get("concat"), list(arrays), {"dim": dim})
+
+
+def from_numpy(a, zero_copy=False):  # noqa: ARG001
+    return array(a)
+
+
+def from_dlpack(capsule):
+    import jax
+    arr = jax.dlpack.from_dlpack(capsule)
+    return NDArray._from_data(arr)
+
+
+def waitall():
+    _engine.waitall()
+
+
+# --------------------------------------------------------------------------
+# save / load — the `.params` role (reference src/ndarray/ndarray.cc ::
+# NDArray::Save/Load via dmlc::Stream).  Container format here is a
+# deterministic npz (documented divergence: reference byte format needs the
+# C++ dmlc stream layout; API and filename conventions are preserved).
+# --------------------------------------------------------------------------
+
+_SAVE_MAGIC = "mxnet_tpu.params.v1"
+
+
+def save(fname, data):
+    payload = {"__magic__": _np.frombuffer(_SAVE_MAGIC.encode(), dtype=_np.uint8)}
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        for k, v in data.items():
+            payload["name:" + k] = v.asnumpy()
+    elif isinstance(data, (list, tuple)):
+        for i, v in enumerate(data):
+            payload[f"idx:{i:08d}"] = v.asnumpy()
+    else:
+        raise MXNetError("save expects NDArray, list or dict of NDArrays")
+    with open(fname, "wb") as f:
+        _np.savez(f, **payload)
+
+
+def load(fname, ctx=None):
+    with _np.load(fname, allow_pickle=False) as z:
+        keys = [k for k in z.files if k != "__magic__"]
+        if keys and keys[0].startswith("name:"):
+            return {k[len("name:"):]: array(z[k], ctx=ctx) for k in sorted(keys)}
+        return [array(z[k], ctx=ctx) for k in sorted(keys)]

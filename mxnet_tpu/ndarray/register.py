@@ -1,0 +1,86 @@
+"""Generate the ``mx.nd.*`` namespaces from the operator registry.
+
+Rebuild of python/mxnet/ndarray/register.py :: _make_ndarray_function — the
+reference introspects the nnvm registry via MXSymbolGetAtomicSymbolInfo and
+writes Python functions at import; we do the same against
+mxnet_tpu.ops.registry.  Dotted op names become sub-namespaces
+(``random.uniform`` → ``mx.nd.random.uniform``) plus flattened aliases
+(``random_uniform``), matching the reference's dual exposure.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as _np
+
+from ..ops import registry as _reg
+from .ndarray import NDArray, array as _array
+
+
+def _make_op_func(op):
+    def fn(*args, out=None, name=None, ctx=None, **attrs):  # noqa: ARG001
+        inputs = []
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            elif isinstance(a, _np.ndarray):
+                inputs.append(_array(a, ctx=ctx))
+            elif a is None:
+                continue
+            else:
+                raise TypeError(
+                    f"operator {op.name}: positional arguments must be "
+                    f"NDArray (got {type(a).__name__}); pass scalars as "
+                    "keyword attributes")
+        return _reg.invoke(op, inputs, attrs, out=out, ctx=ctx)
+
+    fn.__name__ = op.name.split(".")[-1]
+    fn.__doc__ = op.doc or f"auto-generated wrapper for operator {op.name!r}"
+    return fn
+
+
+def populate(target_module, prefix=""):
+    """Install generated functions into target_module.
+
+    Existing attributes are never overwritten (hand-written helpers win).
+    Returns the list of names installed.
+    """
+    installed = []
+    submodules = {}
+    for name in _reg.list_ops():
+        if prefix:
+            if not name.startswith(prefix + "."):
+                continue
+            local = name[len(prefix) + 1:]
+        else:
+            local = name
+        fn = _make_op_func(_reg.get(name))
+        if "." in local:
+            ns, leaf = local.split(".", 1)
+            if "." in leaf:
+                continue  # only one level of nesting in the reference
+            if ns not in submodules:
+                modname = f"{target_module.__name__}.{ns}"
+                mod = sys.modules.get(modname)
+                if mod is None:
+                    mod = types.ModuleType(
+                        modname, f"generated operator namespace {ns!r}")
+                    sys.modules[modname] = mod
+                if not hasattr(target_module, ns):
+                    setattr(target_module, ns, mod)
+                submodules[ns] = getattr(target_module, ns)
+            sub = submodules[ns]
+            if not hasattr(sub, leaf):
+                setattr(sub, leaf, fn)
+                installed.append(f"{ns}.{leaf}")
+            flat = local.replace(".", "_")
+            if not hasattr(target_module, flat):
+                setattr(target_module, flat, fn)
+                installed.append(flat)
+        else:
+            if not hasattr(target_module, local):
+                setattr(target_module, local, fn)
+                installed.append(local)
+    return installed

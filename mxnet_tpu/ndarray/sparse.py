@@ -1,0 +1,205 @@
+"""Sparse NDArrays: row_sparse and CSR.
+
+Rebuild of src/ndarray (NDArrayStorageType kRowSparseStorage/kCSRStorage) and
+python/mxnet/ndarray/sparse.py.  TPU-native design: a sparse array is a pair
+of dense jax buffers ((indices, values) / (indptr, indices, data)) with the
+NDArray op surface; kernels lower to gather/scatter/segment ops which XLA
+vectorizes.  Used by the sparse-embedding / PS path (SURVEY §2.4 row
+"Sparse / large-embedding sharding", BASELINE config 4).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from .ndarray import NDArray, array as _dense_array, zeros as _dense_zeros
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "zeros"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+class BaseSparseNDArray:
+    def __init__(self, shape, ctx=None, dtype=None):
+        self._shape = tuple(shape)
+        self._ctx = ctx if ctx is not None else current_context()
+        self._dtype = _np.dtype(dtype if dtype is not None else _np.float32)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ctx(self):
+        return self._ctx
+
+    context = ctx
+
+    @property
+    def size(self):
+        s = 1
+        for d in self._shape:
+            s *= d
+        return s
+
+    def asnumpy(self):
+        return self.tostype("default").asnumpy()
+
+    def wait_to_read(self):
+        pass
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} "
+                f"{'x'.join(map(str, self.shape))} @{self.ctx}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(indices, values): values[i] is the dense row indices[i]; all other
+    rows are zero.  reference: kRowSparseStorage, gradients of Embedding/dot
+    and the PS sharded-embedding path."""
+
+    def __init__(self, data, indices, shape, ctx=None, dtype=None):
+        dtype = dtype if dtype is not None else getattr(data, "dtype", None)
+        super().__init__(shape, ctx, dtype)
+        self.data = data if isinstance(data, NDArray) else _dense_array(data, ctx=ctx)
+        self.indices = indices if isinstance(indices, NDArray) else \
+            _dense_array(_np.asarray(indices, dtype=_np.int64), ctx=ctx)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype != "default":
+            raise MXNetError(f"cannot convert row_sparse to {stype}")
+        jnp = _jnp()
+        dense = jnp.zeros(self._shape, self._dtype)
+        idx = self.indices._data.astype(jnp.int32)
+        dense = dense.at[idx].set(self.data._data)
+        return NDArray._from_data(dense, ctx=self.ctx)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._set_data(self.tostype("default")._data)
+            return other
+        return RowSparseNDArray(self.data.copy(), self.indices.copy(),
+                                self._shape, ctx=other, dtype=self._dtype)
+
+    def retain(self, row_ids):
+        """sparse_retain: keep only the listed rows (reference
+        src/operator/tensor/sparse_retain.cc)."""
+        jnp = _jnp()
+        rid = row_ids._data.astype(jnp.int64) if isinstance(row_ids, NDArray) \
+            else jnp.asarray(row_ids, jnp.int64)
+        mask = jnp.isin(self.indices._data, rid)
+        keep = _np.nonzero(_np.asarray(mask))[0]
+        return RowSparseNDArray(
+            NDArray._from_data(self.data._data[keep]),
+            NDArray._from_data(self.indices._data[keep]),
+            self._shape, ctx=self.ctx, dtype=self._dtype)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return self.tostype("default") + other.tostype("default")
+        return self.tostype("default") + other
+
+
+class CSRNDArray(BaseSparseNDArray):
+    def __init__(self, data, indptr, indices, shape, ctx=None, dtype=None):
+        dtype = dtype if dtype is not None else getattr(data, "dtype", None)
+        super().__init__(shape, ctx, dtype)
+        self.data = data if isinstance(data, NDArray) else _dense_array(data, ctx=ctx)
+        self.indptr = indptr if isinstance(indptr, NDArray) else \
+            _dense_array(_np.asarray(indptr, dtype=_np.int64), ctx=ctx)
+        self.indices = indices if isinstance(indices, NDArray) else \
+            _dense_array(_np.asarray(indices, dtype=_np.int64), ctx=ctx)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype != "default":
+            raise MXNetError(f"cannot convert csr to {stype}")
+        jnp = _jnp()
+        indptr = _np.asarray(self.indptr._data)
+        rows = _np.repeat(_np.arange(self._shape[0]), _np.diff(indptr))
+        dense = jnp.zeros(self._shape, self._dtype)
+        dense = dense.at[jnp.asarray(rows),
+                         self.indices._data.astype(jnp.int32)].set(self.data._data)
+        return NDArray._from_data(dense, ctx=self.ctx)
+
+    def dot(self, dense):
+        """csr @ dense — lowers to segment-sum (TPU-friendly SpMM)."""
+        import jax
+        jnp = _jnp()
+        indptr = _np.asarray(self.indptr._data)
+        rows = _np.repeat(_np.arange(self._shape[0]), _np.diff(indptr))
+        gathered = dense._data[self.indices._data.astype(jnp.int32)] \
+            * self.data._data[:, None]
+        out = jax.ops.segment_sum(gathered, jnp.asarray(rows),
+                                  num_segments=self._shape[0])
+        return NDArray._from_data(out, ctx=self.ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(data, indices, shape, ctx=ctx, dtype=dtype)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    nz = _np.where(_np.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
+    return RowSparseNDArray(dense[nz], nz.astype(_np.int64),
+                            dense.shape, ctx=ctx, dtype=dtype or dense.dtype)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(data, indptr, indices, shape, ctx=ctx, dtype=dtype)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    rows, cols = _np.nonzero(dense)
+    data = dense[rows, cols]
+    indptr = _np.zeros(dense.shape[0] + 1, dtype=_np.int64)
+    for r in rows:
+        indptr[r + 1] += 1
+    indptr = _np.cumsum(indptr)
+    return CSRNDArray(data, indptr, cols.astype(_np.int64), dense.shape,
+                      ctx=ctx, dtype=dtype or dense.dtype)
+
+
+def cast_storage(arr, stype):
+    """reference src/operator/tensor/cast_storage.cc."""
+    if stype == "default":
+        return arr.tostype("default") if not isinstance(arr, NDArray) else arr
+    if isinstance(arr, NDArray):
+        if stype == "row_sparse":
+            return row_sparse_array(arr, ctx=arr.ctx, dtype=arr.dtype)
+        if stype == "csr":
+            return csr_matrix(arr, ctx=arr.ctx, dtype=arr.dtype)
+    raise MXNetError(f"cast_storage: unsupported target {stype}")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    if stype == "default":
+        return _dense_zeros(shape, ctx=ctx, dtype=dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(_np.zeros((0,) + tuple(shape[1:])),
+                                _np.zeros((0,), _np.int64), shape, ctx, dtype)
+    if stype == "csr":
+        return CSRNDArray(_np.zeros((0,)), _np.zeros(shape[0] + 1, _np.int64),
+                          _np.zeros((0,), _np.int64), shape, ctx, dtype)
+    raise MXNetError(f"unknown stype {stype}")

@@ -1,0 +1,20 @@
+"""Operator corpus (rebuild of src/operator/** — SURVEY §2.2).
+
+Importing this package populates the registry; Python namespaces
+(``mx.nd.*``) are then generated from the registry by
+``mxnet_tpu.ndarray.register`` exactly like the reference generates them from
+nnvm registry introspection at import time.
+"""
+
+from . import registry  # noqa: F401
+from .registry import register, get, list_ops, invoke  # noqa: F401
+
+# registration side effects
+from . import elemwise  # noqa: F401
+from . import reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import linalg  # noqa: F401
+from . import contrib  # noqa: F401

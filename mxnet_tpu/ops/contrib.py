@@ -1,0 +1,231 @@
+"""contrib operators: fused attention, boxes/NMS, misc.
+
+Rebuild of src/operator/contrib/ — most importantly transformer.cc's fused
+attention ops (`_contrib_interleaved_matmul_selfatt_qk` etc., the GluonNLP
+BERT fast path, SURVEY §5.7) and the detection-model box ops.  On TPU the
+attention ops route through one fused attention impl (see
+mxnet_tpu.parallel.attention for the Pallas/flash path); the interleaved
+layout contracts of the reference are preserved at the op boundary.
+"""
+
+from __future__ import annotations
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("contrib.div_sqrt_dim")
+def _div_sqrt_dim(data):
+    jnp = _jnp()
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+# interleaved fused self-attention ops.  Layout contract (reference
+# transformer.cc): qkv is (seq, batch, 3*num_heads*head_dim) with q/k/v
+# interleaved per head: [q_h0, k_h0, v_h0, q_h1, ...] along the last dim.
+
+def _split_interleaved(qkv, heads):
+    jnp = _jnp()
+    L, B, E = qkv.shape
+    hd = E // (3 * heads)
+    x = qkv.reshape(L, B, heads, 3, hd)
+    q = x[:, :, :, 0]
+    k = x[:, :, :, 1]
+    v = x[:, :, :, 2]
+    return q, k, v  # (L, B, H, D)
+
+
+@register("contrib.interleaved_matmul_selfatt_qk")
+def _interleaved_matmul_selfatt_qk(qkv, heads=1):
+    jnp = _jnp()
+    q, k, _ = _split_interleaved(qkv, heads)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    # output (B*H, Lq, Lk) — reference layout
+    return jnp.einsum("qbhd,kbhd->bhqk", q * scale, k).reshape(
+        -1, qkv.shape[0], qkv.shape[0])
+
+
+@register("contrib.interleaved_matmul_selfatt_valatt")
+def _interleaved_matmul_selfatt_valatt(qkv, att, heads=1):
+    jnp = _jnp()
+    _, _, v = _split_interleaved(qkv, heads)
+    L, B = qkv.shape[0], qkv.shape[1]
+    a = att.reshape(B, heads, L, L)
+    out = jnp.einsum("bhqk,kbhd->qbhd", a, v)
+    return out.reshape(L, B, -1)
+
+
+@register("contrib.interleaved_matmul_encdec_qk")
+def _interleaved_matmul_encdec_qk(q, kv, heads=1):
+    jnp = _jnp()
+    Lq, B, E = q.shape
+    hd = E // heads
+    qh = q.reshape(Lq, B, heads, hd)
+    Lk = kv.shape[0]
+    kvh = kv.reshape(Lk, B, heads, 2, hd)
+    k = kvh[:, :, :, 0]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, q.dtype))
+    return jnp.einsum("qbhd,kbhd->bhqk", qh * scale, k).reshape(-1, Lq, Lk)
+
+
+@register("contrib.interleaved_matmul_encdec_valatt")
+def _interleaved_matmul_encdec_valatt(kv, att, heads=1):
+    jnp = _jnp()
+    Lk, B, E2 = kv.shape
+    hd = E2 // (2 * heads)
+    v = kv.reshape(Lk, B, heads, 2, hd)[:, :, :, 1]
+    Lq = att.shape[1]
+    a = att.reshape(B, heads, Lq, Lk)
+    out = jnp.einsum("bhqk,kbhd->qbhd", a, v)
+    return out.reshape(Lq, B, -1)
+
+
+@register("contrib.arange_like", differentiable=False)
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    jnp = _jnp()
+    if axis is None:
+        n = data.size
+    else:
+        n = data.shape[axis]
+    r = start + step * jnp.arange(n, dtype=jnp.float32)
+    if repeat != 1:
+        r = jnp.repeat(r, repeat)
+    return r
+
+
+@register("contrib.index_array", differentiable=False)
+def _index_array(data, axes=None):
+    jnp = _jnp()
+    import numpy as np
+    sh = data.shape
+    axes = tuple(axes) if axes is not None else tuple(range(len(sh)))
+    grids = jnp.meshgrid(*[jnp.arange(sh[a]) for a in axes], indexing="ij")
+    idx = jnp.stack(grids, axis=-1).astype(jnp.int64)
+    full = [idx[..., i] for i in range(len(axes))]
+    out_sh = tuple(sh[a] for a in axes)
+    return jnp.stack(full, axis=-1).reshape(out_sh + (len(axes),))
+
+
+@register("contrib.gradient_multiplier")
+def _gradient_multiplier(data, scalar=1.0):
+    import jax
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (g * scalar,)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("contrib.box_iou", differentiable=False)
+def _box_iou(lhs, rhs, format="corner"):
+    jnp = _jnp()
+    if format == "center":
+        def corner(b):
+            x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+            return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], -1)
+        lhs, rhs = corner(lhs), corner(rhs)
+    l = lhs[..., :, None, :]
+    r = rhs[..., None, :, :]
+    tl = jnp.maximum(l[..., :2], r[..., :2])
+    br = jnp.minimum(l[..., 2:], r[..., 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_l = (l[..., 2] - l[..., 0]) * (l[..., 3] - l[..., 1])
+    area_r = (r[..., 2] - r[..., 0]) * (r[..., 3] - r[..., 1])
+    return inter / (area_l + area_r - inter + 1e-12)
+
+
+@register("contrib.box_nms", differentiable=False, jit=False)
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+             score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+             in_format="corner", out_format="corner"):  # noqa: ARG001
+    """Greedy NMS (reference src/operator/contrib/bounding_box.cc).  Runs in
+    numpy on host — detection postprocessing is host-side in this rebuild."""
+    import numpy as np
+    x = np.asarray(data)
+    orig_shape = x.shape
+    x = x.reshape(-1, x.shape[-2], x.shape[-1])
+    out = np.full_like(x, -1.0)
+    for b in range(x.shape[0]):
+        boxes = x[b]
+        scores = boxes[:, score_index]
+        valid = scores > valid_thresh
+        idx = np.argsort(-scores)
+        idx = idx[valid[idx]]
+        if topk > 0:
+            idx = idx[:topk]
+        keep = []
+        while len(idx):
+            i = idx[0]
+            keep.append(i)
+            if len(idx) == 1:
+                break
+            bi = boxes[i, coord_start:coord_start + 4]
+            rest = boxes[idx[1:], coord_start:coord_start + 4]
+            tl = np.maximum(bi[:2], rest[:, :2])
+            br = np.minimum(bi[2:], rest[:, 2:])
+            wh = np.maximum(br - tl, 0)
+            inter = wh[:, 0] * wh[:, 1]
+            a1 = (bi[2] - bi[0]) * (bi[3] - bi[1])
+            a2 = (rest[:, 2] - rest[:, 0]) * (rest[:, 3] - rest[:, 1])
+            iou = inter / (a1 + a2 - inter + 1e-12)
+            same_cls = (boxes[idx[1:], id_index] == boxes[i, id_index]) \
+                if (id_index >= 0 and not force_suppress) else np.ones(len(iou), bool)
+            idx = idx[1:][~((iou > overlap_thresh) & same_cls)]
+        for j, i in enumerate(keep):
+            out[b, j] = boxes[i]
+    return _jnp().asarray(out.reshape(orig_shape))
+
+
+@register("contrib.quadratic")
+def _quadratic(data, a=0.0, b=0.0, c=0.0):
+    """The tutorial op (reference src/operator/contrib/quadratic_op.cc)."""
+    return a * data * data + b * data + c
+
+
+@register("contrib.allclose", differentiable=False)
+def _allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    jnp = _jnp()
+    return jnp.asarray(jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                    equal_nan=equal_nan), dtype=jnp.float32)
+
+
+@register("contrib.hawkes_ll", num_outputs=2)
+def _hawkes_ll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Hawkes-process log-likelihood (reference contrib/hawkes_ll.cc)."""
+    jnp = _jnp()
+    import jax
+    K = lda.shape[-1]
+    T, N = 0, lags.shape[0]
+    mk = jax.nn.one_hot(marks.astype(jnp.int32), K, dtype=lags.dtype)
+    steps = jnp.arange(lags.shape[1])
+    valid = (steps[None, :] < valid_length[:, None]).astype(lags.dtype)
+
+    def body(carry, xs):
+        st, ll = carry
+        lag, m, v = xs
+        st = st * jnp.exp(-beta * lag[:, None])
+        intensity = lda + alpha * st
+        lam = jnp.sum(intensity * m, axis=-1)
+        ll = ll + v * jnp.log(jnp.maximum(lam, 1e-37))
+        st = st + m
+        return (st, ll), None
+
+    (st, ll), _ = jax.lax.scan(
+        body, (state, jnp.zeros(N, lags.dtype)),
+        (lags.T, jnp.transpose(mk, (1, 0, 2)), valid.T))
+    compens = jnp.sum(lda * max_time[:, None], axis=-1)
+    ll = ll - compens
+    return ll, st

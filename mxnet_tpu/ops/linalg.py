@@ -1,0 +1,171 @@
+"""Linear-algebra operators (reference src/operator/tensor/la_op.cc — the
+``linalg.*`` namespace backed by LAPACK/cuSOLVER; here jnp.linalg/lax.linalg,
+which XLA lowers to TPU-friendly blocked algorithms)."""
+
+from __future__ import annotations
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("linalg.gemm")
+def _gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
+          axis=-2):  # noqa: ARG001
+    jnp = _jnp()
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg.gemm2")
+def _gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):  # noqa: ARG001
+    jnp = _jnp()
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg.syrk")
+def _syrk(A, transpose=False, alpha=1.0):
+    jnp = _jnp()
+    at = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(at, A) if transpose else jnp.matmul(A, at))
+
+
+@register("linalg.potrf")
+def _potrf(A):
+    return _jnp().linalg.cholesky(A)
+
+
+@register("linalg.potri")
+def _potri(L):
+    jnp = _jnp()
+    ident = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype), L.shape)
+    import jax
+    linv = jax.scipy.linalg.solve_triangular(L, ident, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("linalg.trsm")
+def _trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    import jax
+    jnp = _jnp()
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    lo = lower != transpose
+    if rightside:
+        x = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * B, -1, -2),
+            lower=not lo)
+        return jnp.swapaxes(x, -1, -2)
+    return jax.scipy.linalg.solve_triangular(a, alpha * B, lower=lo)
+
+
+@register("linalg.trmm")
+def _trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    jnp = _jnp()
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    tri = jnp.tril(a) if (lower != transpose) else jnp.triu(a)
+    return alpha * (jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B))
+
+
+@register("linalg.sumlogdiag")
+def _sumlogdiag(A):
+    jnp = _jnp()
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg.extractdiag")
+def _extractdiag(A, offset=0):
+    return _jnp().diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg.makediag")
+def _makediag(d, offset=0):
+    jnp = _jnp()
+    n = d.shape[-1] + abs(offset)
+    out = jnp.zeros(d.shape[:-1] + (n, n), dtype=d.dtype)
+    idx = jnp.arange(d.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    return out.at[..., r, c].set(d)
+
+
+@register("linalg.extracttrian")
+def _extracttrian(A, offset=0, lower=True):
+    jnp = _jnp()
+    import numpy as np
+    n = A.shape[-1]
+    if lower:
+        rows, cols = np.tril_indices(n, offset)
+    else:
+        rows, cols = np.triu_indices(n, offset)
+    return A[..., rows, cols]
+
+
+@register("linalg.inverse")
+def _inverse(A):
+    return _jnp().linalg.inv(A)
+
+
+@register("linalg.det")
+def _det(A):
+    return _jnp().linalg.det(A)
+
+
+@register("linalg.slogdet", num_outputs=2)
+def _slogdet(A):
+    s, ld = _jnp().linalg.slogdet(A)
+    return s, ld
+
+
+@register("linalg.svd", num_outputs=3, differentiable=False)
+def _svd(A):
+    jnp = _jnp()
+    u, s, vt = jnp.linalg.svd(A, full_matrices=False)
+    return u, s, vt
+
+
+@register("linalg.eigh", num_outputs=2, differentiable=False)
+def _eigh(A):
+    w, v = _jnp().linalg.eigh(A)
+    return w, v
+
+
+@register("linalg.qr", num_outputs=2, differentiable=False)
+def _qr(A):
+    q, r = _jnp().linalg.qr(A)
+    return q, r
+
+
+@register("linalg.solve")
+def _solve(A, b):
+    return _jnp().linalg.solve(A, b)
+
+
+@register("linalg.tensorinv")
+def _tensorinv(A, ind=2):
+    return _jnp().linalg.tensorinv(A, ind=ind)
+
+
+@register("linalg.norm")
+def _linalg_norm(A, ord=None, axis=None, keepdims=False):
+    return _jnp().linalg.norm(A, ord=ord, axis=axis, keepdims=keepdims)
+
+
+@register("linalg.matrix_rank", differentiable=False)
+def _matrix_rank(A, tol=None):
+    return _jnp().linalg.matrix_rank(A, tol=tol)
+
+
+@register("linalg.pinv", differentiable=False)
+def _pinv(A, rcond=1e-15):
+    return _jnp().linalg.pinv(A, rcond)
+
+
+@register("einsum")
+def _einsum(*operands, subscripts=""):
+    return _jnp().einsum(subscripts, *operands)

@@ -1,0 +1,408 @@
+"""Matrix / shape-manipulation / indexing operators.
+
+Rebuild of src/operator/tensor/matrix_op.cc (reshape/transpose/slice/concat/
+clip/repeat/tile/pad/flip/...), dot.cc (dense matmul family),
+indexing_op.cc (take/gather_nd/scatter_nd/one_hot/Embedding), init_op.cc and
+control_flow_op.cc (where).  All matmul-family ops go through lax.dot_general
+with a configurable precision so float32 runs on the MXU with the policy set
+by MXNET_TPU_DEFAULT_MATMUL_PRECISION.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+from .. import config
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _precision():
+    return config.get("MXNET_TPU_DEFAULT_MATMUL_PRECISION", "default")
+
+
+# -- matmul family ----------------------------------------------------------
+
+@register("dot")
+def _dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """reference src/operator/tensor/dot.cc :: dot — 2D (and ND) product:
+    for ND inputs, contracts last axis of lhs with first axis of rhs."""
+    jnp = _jnp()
+    a = lhs.T if (transpose_a and lhs.ndim == 2) else lhs
+    b = rhs.T if (transpose_b and rhs.ndim == 2) else rhs
+    if transpose_a and lhs.ndim != 2:
+        a = jnp.moveaxis(lhs, 0, -1)
+    if transpose_b and rhs.ndim != 2:
+        b = jnp.moveaxis(rhs, -1, 0)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b, precision=_precision())
+    return jnp.tensordot(a, b, axes=1, precision=_precision())
+
+
+@register("batch_dot")
+def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    jnp = _jnp()
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b, precision=_precision())
+
+
+@register("matmul")
+def _matmul(a, b):
+    return _jnp().matmul(a, b, precision=_precision())
+
+
+@register("khatri_rao")
+def _khatri_rao(*mats):
+    jnp = _jnp()
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(-1, out.shape[-1])
+    return out
+
+
+# -- shape manipulation -----------------------------------------------------
+
+@register("reshape")
+def _reshape(x, shape=None, reverse=False):  # noqa: ARG001 - reverse rare
+    from ..ndarray.ndarray import _infer_reshape
+    return x.reshape(_infer_reshape(x.shape, tuple(shape)))
+
+
+@register("_slice_basic")
+def _slice_basic(x, key=None):
+    from ..ndarray.ndarray import _thaw_index
+    return x[_thaw_index(key)]
+
+
+@register("transpose")
+def _transpose(x, axes=None):
+    return _jnp().transpose(x, axes if axes else None)
+
+
+@register("expand_dims")
+def _expand_dims(x, axis=0):
+    return _jnp().expand_dims(x, axis)
+
+
+@register("squeeze")
+def _squeeze(x, axis=None):
+    return _jnp().squeeze(x, axis)
+
+
+@register("swapaxes")
+def _swapaxes(x, dim1=0, dim2=0):
+    return _jnp().swapaxes(x, dim1, dim2)
+
+
+@register("flatten")
+def _flatten(x):
+    return x.reshape(x.shape[0], -1)
+
+
+@register("broadcast_to")
+def _broadcast_to(x, shape=None):
+    shape = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(shape))
+    return _jnp().broadcast_to(x, shape)
+
+
+@register("broadcast_like")
+def _broadcast_like(x, like):
+    return _jnp().broadcast_to(x, like.shape)
+
+
+@register("broadcast_axis")
+def _broadcast_axis(x, axis=None, size=None):
+    jnp = _jnp()
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(x.shape)
+    for a, s in zip(axes, sizes):
+        shape[a] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register("slice")
+def _slice(x, begin=None, end=None, step=None):
+    sl = []
+    for i in range(len(begin)):
+        st = step[i] if step else 1
+        sl.append(slice(begin[i], end[i], st))
+    return x[tuple(sl)]
+
+
+@register("slice_axis")
+def _slice_axis(x, axis=0, begin=0, end=None):
+    sl = [slice(None)] * x.ndim
+    sl[axis] = slice(begin, end)
+    return x[tuple(sl)]
+
+
+@register("slice_like")
+def _slice_like(x, like, axes=()):
+    sl = [slice(None)] * x.ndim
+    axes = axes if axes else range(x.ndim)
+    for a in axes:
+        sl[a] = slice(0, like.shape[a])
+    return x[tuple(sl)]
+
+
+@register("concat")
+def _concat(*args, dim=1):
+    return _jnp().concatenate(args, axis=dim)
+
+
+@register("stack")
+def _stack(*args, axis=0):
+    return _jnp().stack(args, axis=axis)
+
+
+@register("split", num_outputs=-1)
+def _split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    jnp = _jnp()
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return parts if len(parts) > 1 else parts[0]
+
+
+@register("split_v2", num_outputs=-1)
+def _split_v2(x, indices=None, axis=0, squeeze_axis=False, sections=0):
+    jnp = _jnp()
+    if sections:
+        parts = jnp.split(x, sections, axis=axis)
+    else:
+        parts = jnp.split(x, list(indices), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return parts if len(parts) > 1 else parts[0]
+
+
+@register("slice_channel", num_outputs=-1)
+def _slice_channel(x, num_outputs=1, axis=1, squeeze_axis=False):
+    return _split(x, num_outputs=num_outputs, axis=axis,
+                  squeeze_axis=squeeze_axis)
+
+
+@register("tile")
+def _tile(x, reps=()):
+    return _jnp().tile(x, reps)
+
+
+@register("repeat")
+def _repeat(x, repeats=1, axis=None):
+    return _jnp().repeat(x, repeats, axis=axis)
+
+
+@register("flip")
+def _flip(x, axis=None):
+    return _jnp().flip(x, axis=axis)
+
+
+@register("reverse")
+def _reverse(x, axis=None):
+    return _jnp().flip(x, axis=axis)
+
+
+@register("pad")
+def _pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    jnp = _jnp()
+    pw = []
+    it = iter(pad_width)
+    for lo in it:
+        pw.append((lo, next(it)))
+    mode_map = {"constant": "constant", "edge": "edge", "reflect": "reflect"}
+    if mode == "constant":
+        return jnp.pad(x, pw, mode="constant", constant_values=constant_value)
+    return jnp.pad(x, pw, mode=mode_map[mode])
+
+
+@register("where")
+def _where(cond, x, y):
+    return _jnp().where(cond != 0, x, y)
+
+
+@register("diag")
+def _diag(x, k=0):
+    jnp = _jnp()
+    if x.ndim == 1:
+        return jnp.diag(x, k)
+    return jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)
+
+
+@register("eye", differentiable=False)
+def _eye(N=1, M=0, k=0, dtype="float32"):
+    return _jnp().eye(int(N), int(M) if M else None, k=int(k), dtype=dtype)
+
+
+@register("depth_to_space")
+def _depth_to_space(x, block_size=1):
+    jnp = _jnp()
+    b, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(b, bs, bs, c // (bs * bs), h, w)
+    y = jnp.transpose(y, (0, 3, 4, 1, 5, 2))
+    return y.reshape(b, c // (bs * bs), h * bs, w * bs)
+
+
+@register("space_to_depth")
+def _space_to_depth(x, block_size=1):
+    jnp = _jnp()
+    b, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(b, c, h // bs, bs, w // bs, bs)
+    y = jnp.transpose(y, (0, 3, 5, 1, 2, 4))
+    return y.reshape(b, c * bs * bs, h // bs, w // bs)
+
+
+# -- indexing ---------------------------------------------------------------
+
+@register("take")
+def _take(a, indices, axis=0, mode="clip"):
+    jnp = _jnp()
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    return jnp.take(a, idx, axis=axis, mode="clip")
+
+
+@register("Embedding")
+def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+               sparse_grad=False):  # noqa: ARG001
+    """reference src/operator/tensor/indexing_op.cc :: Embedding."""
+    jnp = _jnp()
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("one_hot", differentiable=False)
+def _one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32"):
+    import jax
+    jnp = _jnp()
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dtype)
+    return oh * (on_value - off_value) + off_value
+
+
+@register("pick")
+def _pick(data, index, axis=-1, keepdims=False, mode="clip"):  # noqa: ARG001
+    jnp = _jnp()
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    picked = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    return picked if keepdims else jnp.squeeze(picked, axis=axis)
+
+
+@register("gather_nd")
+def _gather_nd(data, indices):
+    jnp = _jnp()
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=None):
+    jnp = _jnp()
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].add(data)
+
+
+@register("boolean_mask", jit=False, differentiable=False)
+def _boolean_mask(data, index, axis=0):
+    # dynamic output shape — cannot be jitted with static shapes; runs eager
+    # (reference contrib/boolean_mask.cc has the same dynamic-shape caveat)
+    import numpy as np
+    mask = np.asarray(index) != 0
+    return _jnp().compress(mask, data, axis=axis)
+
+
+@register("sequence_mask")
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                   value=0.0, axis=0):
+    jnp = _jnp()
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    # data layout: (max_sequence_length, batch, ...) when axis==0
+    mask = steps[:, None] < sequence_length[None, :].astype(jnp.int32)
+    if axis == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, value)
+
+
+@register("sequence_last")
+def _sequence_last(data, sequence_length=None, use_sequence_length=False,
+                   axis=0):
+    jnp = _jnp()
+    if not use_sequence_length or sequence_length is None:
+        sl = [slice(None)] * data.ndim
+        sl[axis] = -1
+        return data[tuple(sl)]
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:
+        return jnp.take_along_axis(
+            data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+    return jnp.take_along_axis(
+        data, idx.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1)[:, 0]
+
+
+@register("sequence_reverse")
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                      axis=0):
+    jnp = _jnp()
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    maxlen = data.shape[0]
+    steps = jnp.arange(maxlen)[:, None]
+    lens = sequence_length.astype(jnp.int32)[None, :]
+    rev_idx = jnp.where(steps < lens, lens - 1 - steps, steps)
+    return jnp.take_along_axis(
+        data, rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2)), axis=0)
+
+
+@register("index_copy")
+def _index_copy(old, index, new):
+    jnp = _jnp()
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("index_add")
+def _index_add(old, index, new):
+    jnp = _jnp()
+    return old.at[index.astype(jnp.int32)].add(new)
+
+
+# -- init-style ops (no array inputs) --------------------------------------
+
+@register("_zeros", differentiable=False)
+def _zeros_op(shape=(), dtype="float32"):
+    return _jnp().zeros(tuple(shape), dtype)
+
+
+@register("_ones", differentiable=False)
+def _ones_op(shape=(), dtype="float32"):
+    return _jnp().ones(tuple(shape), dtype)
+
+
+@register("_full", differentiable=False)
+def _full_op(shape=(), value=0.0, dtype="float32"):
+    return _jnp().full(tuple(shape), value, dtype)
+
+
+@register("_arange", differentiable=False)
+def _arange_op(start=0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    jnp = _jnp()
+    r = jnp.arange(start, stop, step, dtype)
+    if repeat != 1:
+        r = jnp.repeat(r, repeat)
+    return r
+
+
+@register("linspace", differentiable=False)
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32"):
+    return _jnp().linspace(start, stop, int(num), endpoint=endpoint,
+                           dtype=dtype)

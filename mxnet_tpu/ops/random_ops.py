@@ -1,0 +1,158 @@
+"""Random sampling operators over the stateful-RNG facade.
+
+Rebuild of src/operator/random/sample_op.cc (uniform/normal/gamma/exponential/
+poisson/negative_binomial/generalized_negative_binomial/randint),
+sample_multinomial_op.cc and shuffle_op.cc.  Each op declares
+``wrap_key='_key'``: dispatch splits the current context's stateful key
+(mxnet_tpu.random) and passes it in, so the public API stays stateful like the
+reference while the kernels stay functional (SURVEY §7.3 item 7 — parity is
+distribution-level, not bitwise).
+"""
+
+from __future__ import annotations
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jr():
+    import jax.random as jr
+    return jr
+
+
+def _sampler(name, draw):
+    def impl(shape=(), dtype="float32", _key=None, **kw):
+        return draw(_jr(), _key, tuple(shape), dtype, **kw)
+    impl.__name__ = name
+    register(name, differentiable=False, wrap_key="_key")(impl)
+
+
+_sampler("random.uniform",
+         lambda jr, key, shape, dtype, low=0.0, high=1.0:
+         jr.uniform(key, shape, dtype, minval=low, maxval=high))
+_sampler("random.normal",
+         lambda jr, key, shape, dtype, loc=0.0, scale=1.0:
+         jr.normal(key, shape, dtype) * scale + loc)
+_sampler("random.gamma",
+         lambda jr, key, shape, dtype, alpha=1.0, beta=1.0:
+         jr.gamma(key, alpha, shape, dtype) * beta)
+_sampler("random.exponential",
+         lambda jr, key, shape, dtype, lam=1.0:
+         jr.exponential(key, shape, dtype) / lam)
+_sampler("random.poisson",
+         lambda jr, key, shape, dtype, lam=1.0:
+         jr.poisson(key, lam, shape).astype(dtype))
+_sampler("random.randint",
+         lambda jr, key, shape, dtype, low=0, high=100:
+         jr.randint(key, shape, int(low), int(high),
+                    dtype if dtype != "float32" else "int32"))
+_sampler("random.negative_binomial",
+         lambda jr, key, shape, dtype, k=1, p=1.0:
+         _negbin(jr, key, shape, dtype, k, p))
+_sampler("random.generalized_negative_binomial",
+         lambda jr, key, shape, dtype, mu=1.0, alpha=1.0:
+         _gnegbin(jr, key, shape, dtype, mu, alpha))
+
+
+def _negbin(jr, key, shape, dtype, k, p):
+    k1, k2 = jr.split(key)
+    lam = jr.gamma(k1, k, shape) * (1 - p) / p
+    return jr.poisson(k2, lam, shape).astype(dtype)
+
+
+def _gnegbin(jr, key, shape, dtype, mu, alpha):
+    k1, k2 = jr.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jr.gamma(k1, r, shape) * (1 - p) / p
+    return jr.poisson(k2, lam, shape).astype(dtype)
+
+
+# element-wise-parameter samplers (reference `_sample_*` taking array params)
+
+def _esampler(name, draw):
+    def impl(*params, shape=(), dtype="float32", _key=None):
+        return draw(_jr(), _key, tuple(shape), dtype, *params)
+    impl.__name__ = name
+    register(name, differentiable=False, wrap_key="_key")(impl)
+
+
+_esampler("sample_uniform",
+          lambda jr, key, shape, dtype, low, high:
+          jr.uniform(key, low.shape + shape, dtype) * (high - low).reshape(
+              low.shape + (1,) * len(shape)) + low.reshape(low.shape + (1,) * len(shape)))
+_esampler("sample_normal",
+          lambda jr, key, shape, dtype, mu, sigma:
+          jr.normal(key, mu.shape + shape, dtype) * sigma.reshape(
+              sigma.shape + (1,) * len(shape)) + mu.reshape(mu.shape + (1,) * len(shape)))
+_esampler("sample_gamma",
+          lambda jr, key, shape, dtype, alpha, beta:
+          jr.gamma(key, alpha.reshape(alpha.shape + (1,) * len(shape)),
+                   alpha.shape + shape, dtype) * beta.reshape(beta.shape + (1,) * len(shape)))
+_esampler("sample_exponential",
+          lambda jr, key, shape, dtype, lam:
+          jr.exponential(key, lam.shape + shape, dtype) / lam.reshape(
+              lam.shape + (1,) * len(shape)))
+_esampler("sample_poisson",
+          lambda jr, key, shape, dtype, lam:
+          jr.poisson(key, lam.reshape(lam.shape + (1,) * len(shape)),
+                     lam.shape + shape).astype(dtype))
+
+
+@register("sample_multinomial", differentiable=False, wrap_key="_key")
+def _sample_multinomial(data, shape=(), get_prob=False, dtype="int32",
+                        _key=None):
+    """reference sample_multinomial_op.cc — data is (…, k) probabilities."""
+    import jax
+    jnp = _jnp()
+    jr = _jr()
+    n = 1
+    for s in (shape if isinstance(shape, tuple) else (shape,)):
+        n *= s if s else 1
+    shp = shape if isinstance(shape, tuple) else ((shape,) if shape else ())
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    out_shape = data.shape[:-1] + shp
+    draw = jr.categorical(_key, logits, axis=-1,
+                          shape=shp + data.shape[:-1])
+    # move sample dims after batch dims
+    if shp:
+        draw = jnp.moveaxis(draw, tuple(range(len(shp))),
+                            tuple(range(-len(shp), 0)))
+    draw = draw.reshape(out_shape).astype(dtype)
+    if get_prob:
+        lp = jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                                 draw.astype(jnp.int32)[..., None], axis=-1)
+        return [draw, lp[..., 0]]
+    return draw
+
+
+@register("shuffle", differentiable=False, wrap_key="_key")
+def _shuffle(data, _key=None):
+    return _jr().permutation(_key, data, axis=0)
+
+
+@register("random.bernoulli", differentiable=False, wrap_key="_key")
+def _bernoulli(shape=(), p=0.5, dtype="float32", _key=None):
+    return _jr().bernoulli(_key, p, tuple(shape)).astype(dtype)
+
+
+@register("gumbel_softmax", wrap_key="_key")
+def _gumbel_softmax(logits, tau=1.0, hard=False, _key=None):
+    import jax
+    jnp = _jnp()
+    g = _jr().gumbel(_key, logits.shape, logits.dtype)
+    y = jax.nn.softmax((logits + g) / tau, axis=-1)
+    if hard:
+        idx = jnp.argmax(y, axis=-1)
+        y_hard = jax.nn.one_hot(idx, logits.shape[-1], dtype=logits.dtype)
+        y = jax.lax.stop_gradient(y_hard - y) + y  # straight-through
+    return y
+
+
+# reference exposes the multinomial sampler under both names
+register("random.multinomial", differentiable=False,
+         wrap_key="_key")(_sample_multinomial)

@@ -1,0 +1,161 @@
+"""Reductions, ordering, and index-reductions.
+
+Rebuild of src/operator/tensor/broadcast_reduce_op_{value,index}.cc and
+ordering_op.cc (topk/sort/argsort).  MXNet reduce semantics preserved:
+``axis=None`` reduces all; ``exclude=True`` reduces every axis *except* the
+given ones (reference ReduceAxesParam::exclude).
+"""
+
+from __future__ import annotations
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _axes(x, axis, exclude=False):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % x.ndim for a in axis)
+    if exclude:
+        axis = tuple(a for a in range(x.ndim) if a not in axis)
+    return axis
+
+
+def _reduce(name, f, differentiable=True):
+    def impl(x, axis=None, keepdims=False, exclude=False):
+        return f(_jnp(), x, _axes(x, axis, exclude), keepdims)
+    impl.__name__ = name
+    register(name, differentiable=differentiable)(impl)
+
+
+_reduce("sum", lambda jnp, x, ax, kd: jnp.sum(x, axis=ax, keepdims=kd))
+_reduce("mean", lambda jnp, x, ax, kd: jnp.mean(x, axis=ax, keepdims=kd))
+_reduce("prod", lambda jnp, x, ax, kd: jnp.prod(x, axis=ax, keepdims=kd))
+_reduce("max", lambda jnp, x, ax, kd: jnp.max(x, axis=ax, keepdims=kd))
+_reduce("min", lambda jnp, x, ax, kd: jnp.min(x, axis=ax, keepdims=kd))
+_reduce("nansum", lambda jnp, x, ax, kd: jnp.nansum(x, axis=ax, keepdims=kd))
+_reduce("nanprod", lambda jnp, x, ax, kd: jnp.nanprod(x, axis=ax, keepdims=kd))
+_reduce("sum_axis", lambda jnp, x, ax, kd: jnp.sum(x, axis=ax, keepdims=kd))
+_reduce("logsumexp", lambda jnp, x, ax, kd: _lse(x, ax, kd))
+
+
+def _lse(x, ax, kd):
+    import jax
+    return jax.scipy.special.logsumexp(x, axis=ax, keepdims=kd)
+
+
+@register("norm")
+def _norm(x, ord=2, axis=None, keepdims=False):
+    jnp = _jnp()
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+
+
+@register("argmax", differentiable=False)
+def _argmax(x, axis=None, keepdims=False):
+    jnp = _jnp()
+    r = jnp.argmax(x, axis=axis, keepdims=keepdims)
+    return r.astype(jnp.float32)  # reference returns float indices
+
+
+@register("argmin", differentiable=False)
+def _argmin(x, axis=None, keepdims=False):
+    jnp = _jnp()
+    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(x):
+    jnp = _jnp()
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register("sort")
+def _sort(x, axis=-1, is_ascend=True):
+    jnp = _jnp()
+    r = jnp.sort(x, axis=axis)
+    if not is_ascend:
+        r = jnp.flip(r, axis=axis)
+    return r
+
+
+@register("argsort", differentiable=False)
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    jnp = _jnp()
+    r = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        r = jnp.flip(r, axis=axis)
+    return r.astype(dtype)
+
+
+@register("topk", differentiable=False, num_outputs=-1)
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """reference src/operator/tensor/ordering_op.cc :: TopK.
+
+    ret_typ: 'value' | 'indices' | 'mask' | 'both'.
+    """
+    import jax
+    jnp = _jnp()
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    ax = axis % x.ndim
+    xt = jnp.moveaxis(x, ax, -1)
+    vals, idx = jax.lax.top_k(-xt if is_ascend else xt, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx.astype(dtype)
+    if ret_typ == "mask":
+        xt_flat = xt.reshape(-1, xt.shape[-1])
+        idx_t = jnp.moveaxis(idx, ax, -1).reshape(-1, k)
+        rows = jnp.arange(xt_flat.shape[0])[:, None]
+        mask = jnp.zeros_like(xt_flat, dtype=jnp.int32).at[rows, idx_t].set(1)
+        return jnp.moveaxis(mask.reshape(xt.shape), -1, ax)
+    return [vals, idx.astype(dtype)]  # 'both'
+
+
+@register("cumsum")
+def _cumsum(x, axis=None, dtype=None):
+    jnp = _jnp()
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    r = jnp.cumsum(x, axis=axis)
+    return r.astype(dtype) if dtype else r
+
+
+@register("cumprod")
+def _cumprod(x, axis=None):
+    jnp = _jnp()
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumprod(x, axis=axis)
+
+
+@register("moments", num_outputs=2)
+def _moments(x, axes=None, keepdims=False):
+    jnp = _jnp()
+    ax = tuple(axes) if axes is not None else None
+    mean = jnp.mean(x, axis=ax, keepdims=keepdims)
+    var = jnp.var(x, axis=ax, keepdims=keepdims)
+    return mean, var
+
+
+@register("histogram", differentiable=False, num_outputs=2, jit=False)
+def _histogram(x, bin_cnt=10, range=None):
+    jnp = _jnp()
+    hist, edges = jnp.histogram(x, bins=bin_cnt, range=range)
+    return hist, edges

@@ -1,0 +1,245 @@
+"""Operator registry + imperative dispatch — the rebuild of nnvm's op registry
+and the imperative invoke path.
+
+Reference anchors (SURVEY §2 N4/N7/N25, §3.1):
+ - ``NNVM_REGISTER_OP(name).set_attr<FCompute>(...)`` — C++ attribute registry.
+ - ``src/imperative/imperative.cc :: Imperative::Invoke`` + ``InvokeOp`` — the
+   eager path: infer shape/type, record on the autograd tape, push to engine.
+ - ``python/mxnet/ndarray/register.py`` — Python namespaces *generated from the
+   registry* at import.
+
+TPU-native design: an op is a JAX-traceable Python callable
+``fn(*jax_arrays, **attrs) -> array | tuple``.  Shape/dtype inference comes
+free from JAX abstract evaluation (no FInferShape/FInferType to write);
+gradients come free from JAX autodiff (FGradient only where semantics diverge,
+via ``custom_vjp`` inside the impl).  Imperative dispatch optionally routes
+through a per-(op, attrs) ``jax.jit`` cache — XLA then specializes per
+shape/dtype, which is the TPU analog of the reference's kernel dispatch.
+When autograd is recording, we capture ``jax.vjp`` residuals at dispatch time
+(the tape stores concrete vjp closures, so backward never re-runs forward).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+from ..base import MXNetError
+from .. import config, engine
+
+__all__ = ["Op", "register", "get", "list_ops", "invoke", "invoke_arrays"]
+
+_REGISTRY: dict = {}
+_ndarray_mod = None  # set by mxnet_tpu.ndarray at import (late-bound to break cycle)
+
+
+def _nd():
+    global _ndarray_mod
+    if _ndarray_mod is None:
+        from .. import ndarray as _m
+        _ndarray_mod = _m.ndarray
+    return _ndarray_mod
+
+
+class Op:
+    """One registered operator.
+
+    Attributes
+    ----------
+    name : registry name; dots create sub-namespaces (``random.uniform`` →
+        ``mx.nd.random.uniform``), leading ``_`` marks internal.
+    fn : the JAX impl, ``fn(*arrays, **attrs)``.
+    num_outputs : static output count, or -1 (tuple of variable length).
+    differentiable : False for int-valued/sampling ops — recording skips them
+        (reference ops mark these with zero FGradient).
+    mutate_inputs : pairs ``(out_idx, in_idx)`` — output out_idx is written
+        back into input in_idx's slot (reference FMutateInputs, e.g. BatchNorm
+        running stats).  The impl *returns* updated values (functional);
+        dispatch performs the slot writeback.
+    wrap_key : if not None, dispatch injects a fresh PRNG key kwarg under this
+        name (stateful-RNG facade, see mxnet_tpu.random).
+    """
+
+    __slots__ = ("name", "fn", "num_outputs", "differentiable",
+                 "mutate_inputs", "wrap_key", "wrap_train", "doc", "jit",
+                 "visible_outputs", "dynamic_attrs")
+
+    def __init__(self, name, fn, num_outputs=1, differentiable=True,
+                 mutate_inputs=(), wrap_key=None, wrap_train=None, jit=True,
+                 doc=None, visible_outputs=None, dynamic_attrs=()):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.differentiable = differentiable
+        self.mutate_inputs = tuple(mutate_inputs)
+        self.wrap_key = wrap_key
+        self.wrap_train = wrap_train
+        self.jit = jit
+        self.doc = doc if doc is not None else fn.__doc__
+        # visible_outputs: how many outputs the *caller* sees (reference
+        # "visible outputs" concept — BatchNorm returns 1 of its 3).
+        self.visible_outputs = visible_outputs
+        # dynamic_attrs: scalar attrs passed as *traced* jit arguments so a
+        # per-step-varying value (lr schedule, lamb's t) does not trigger a
+        # fresh XLA compile per value.
+        self.dynamic_attrs = tuple(dynamic_attrs)
+
+    def __repr__(self):
+        return f"<Op {self.name}>"
+
+
+def register(name, **kwargs):
+    """Decorator: ``@register("dot")`` — the NNVM_REGISTER_OP analog."""
+    def deco(fn):
+        if name in _REGISTRY:
+            raise MXNetError(f"op {name!r} already registered")
+        _REGISTRY[name] = Op(name, fn, **kwargs)
+        return fn
+    return deco
+
+
+def get(name):
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise MXNetError(f"no such operator: {name!r}") from None
+
+
+def list_ops():
+    return sorted(_REGISTRY)
+
+
+# --------------------------------------------------------------------------
+# dispatch
+# --------------------------------------------------------------------------
+
+_jit_cache: dict = {}
+_jit_lock = threading.Lock()
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+def _callable_for(op, attrs):
+    """A positional-only callable with attrs bound, jitted when enabled.
+
+    Attrs named in op.dynamic_attrs holding plain numbers are passed as traced
+    jit arguments (one compile covers all their values); everything else is a
+    static part of the cache key.
+    """
+    dyn = {k: attrs[k] for k in op.dynamic_attrs
+           if k in attrs and isinstance(attrs[k], (int, float))
+           and not isinstance(attrs[k], bool)}
+    static = {k: v for k, v in attrs.items() if k not in dyn}
+    if not (op.jit and config.get_int("MXNET_TPU_JIT_IMPERATIVE", 1)):
+        return functools.partial(op.fn, **attrs) if attrs else op.fn
+    dyn_keys = tuple(sorted(dyn))
+    key = (op.name, _freeze(static), dyn_keys)
+    try:
+        jf = _jit_cache.get(key)
+    except TypeError:  # unhashable attr (e.g. a traced array kwarg) — no cache
+        return functools.partial(op.fn, **attrs) if attrs else op.fn
+    if jf is None:
+        import jax
+
+        def wrapper(_dyn_vals, *arrays, _fn=op.fn, _static=static,
+                    _dyn_keys=dyn_keys):
+            kw = dict(_static)
+            kw.update(zip(_dyn_keys, _dyn_vals))
+            return _fn(*arrays, **kw)
+
+        with _jit_lock:
+            jf = _jit_cache.setdefault(key, jax.jit(wrapper))
+    dyn_vals = tuple(dyn[k] for k in dyn_keys)
+    return lambda *arrays: jf(dyn_vals, *arrays)
+
+
+def invoke_arrays(op, arrays, attrs):
+    """Run an op on raw jax arrays (no NDArray wrapping, no tape)."""
+    f = _callable_for(op, attrs)
+    return f(*arrays)
+
+
+def _normalize_out(op, raw):
+    if isinstance(raw, (tuple, list)):
+        return list(raw)
+    return [raw]
+
+
+def invoke(op, inputs, attrs=None, out=None, ctx=None):
+    """The Imperative::Invoke analog.
+
+    inputs : list of NDArray (reads).
+    out : None | NDArray | list[NDArray] — in-place destination(s); written
+        via slot swap (versioned-buffer discipline, SURVEY §7.1 N3 row).
+    Returns NDArray or list of NDArrays.
+    """
+    from .. import autograd
+    nd = _nd()
+    if isinstance(op, str):
+        op = get(op)
+    attrs = dict(attrs) if attrs else {}
+
+    in_ctx = None
+    for a in inputs:
+        if isinstance(a, nd.NDArray):
+            in_ctx = a.ctx
+            break
+    if in_ctx is None:
+        from ..context import current_context
+        in_ctx = ctx if ctx is not None else current_context()
+
+    arrays = [a._data if isinstance(a, nd.NDArray) else a for a in inputs]
+
+    if op.wrap_key is not None:
+        from .. import random as _rnd
+        attrs[op.wrap_key] = _rnd.get_key(in_ctx)
+    if op.wrap_train is not None and op.wrap_train not in attrs:
+        attrs[op.wrap_train] = autograd.is_training()
+
+    recording = autograd.is_recording() and op.differentiable
+    if recording:
+        # capture residuals now; backward replays the stored closure only
+        import jax
+        f = _callable_for(op, attrs)
+        out_raw, vjp_fn = jax.vjp(f, *arrays)
+    else:
+        out_raw = invoke_arrays(op, arrays, attrs)
+        vjp_fn = None
+
+    out_arrays = _normalize_out(op, out_raw)
+    engine.on_dispatch(out_arrays)
+
+    # mutate_inputs ops (running stats etc.): write back into input slots
+    for out_idx, in_idx in op.mutate_inputs:
+        dst = inputs[in_idx]
+        if isinstance(dst, nd.NDArray):
+            dst._set_data(out_arrays[out_idx])
+
+    # materialize outputs
+    if out is None:
+        results = [nd.NDArray._from_data(a, ctx=in_ctx) for a in out_arrays]
+    else:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        if len(outs) != len(out_arrays):
+            raise MXNetError(
+                f"op {op.name}: {len(out_arrays)} outputs but {len(outs)} out= arrays")
+        for dst, arr in zip(outs, out_arrays):
+            dst._set_data(arr)
+        results = list(outs)
+
+    if recording:
+        autograd._record(op, vjp_fn, inputs, results, attrs)
+
+    if op.visible_outputs is not None and out is None:
+        results = results[:op.visible_outputs]
+    if len(results) == 1 and op.num_outputs in (1, -1):
+        return results[0]
+    if op.visible_outputs == 1:
+        return results[0]
+    return results

@@ -1,0 +1,92 @@
+"""Stateful RNG facade over JAX's functional PRNG.
+
+Rebuild of the reference's ``python/mxnet/random.py`` + per-device
+counter-based generators (src/common/random_generator.h, N21).  MXNet exposes
+a *stateful* RNG (``mx.random.seed(42); mx.nd.random.uniform(...)``); JAX is
+functional (explicit keys).  We hide a per-context key behind the stateful
+API: every draw splits the context's key, so call order determines the stream
+exactly like the reference's per-device generators.  Parity is
+distribution-level, not bitwise (SURVEY §7.3 item 7).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+__all__ = ["seed", "get_key", "fork_key", "generator_of"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+class _CtxGenerator:
+    """Mirrors a per-device random generator: one evolving key per context."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, seed_val):
+        import jax
+        self.key = jax.random.PRNGKey(seed_val)
+
+    def next_key(self):
+        import jax
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+def _generators():
+    if not hasattr(_state, "gens"):
+        _state.gens = {}
+        _state.seed = _DEFAULT_SEED
+    return _state.gens
+
+
+def _dev_offset(dev_key):
+    """Deterministic per-device stream offset (stable across processes —
+    Python's str hash is randomized, so zlib.crc32 instead)."""
+    import zlib
+    return zlib.crc32(f"{dev_key[0]}:{dev_key[1]}".encode()) & 0xFFFF
+
+
+def generator_of(ctx):
+    """The stateful generator for a context (created on first use)."""
+    gens = _generators()
+    k = (ctx.device_type, ctx.device_id)
+    if k not in gens:
+        # Offset per device so different devices get different streams from
+        # the same seed — parity with the reference's per-device generators.
+        gens[k] = _CtxGenerator(_state.seed + _dev_offset(k))
+    return gens[k]
+
+
+def seed(seed_state, ctx="all"):
+    """mx.random.seed — reseed generators (all contexts or one).
+
+    Reference: python/mxnet/random.py :: seed(seed_state, ctx='all').
+    """
+    if not isinstance(seed_state, (int, _np.integer)):
+        raise ValueError("seed_state must be an integer")
+    seed_state = int(seed_state)
+    gens = _generators()
+    if ctx == "all":
+        _state.seed = seed_state
+        gens.clear()
+    else:
+        k = (ctx.device_type, ctx.device_id)
+        gens[k] = _CtxGenerator(seed_state + _dev_offset(k))
+
+
+def get_key(ctx=None):
+    """Split and return a fresh PRNG key from the context's stream."""
+    if ctx is None:
+        from .context import current_context
+        ctx = current_context()
+    return generator_of(ctx).next_key()
+
+
+def fork_key(ctx=None, num=2):
+    import jax
+    k = get_key(ctx)
+    return jax.random.split(k, num)
