@@ -1,0 +1,42 @@
+import numpy as np, time
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+t0=time.time()
+def log(*a): print(f"[{time.time()-t0:5.1f}s]", *a, flush=True)
+ctx = mx.tpu()
+with ctx:
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    # dense net first
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation='relu'), nn.Dense(10))
+    net.initialize(); net.hybridize()
+    x = mx.nd.array(np.random.randn(32, 784).astype('float32'), ctx=ctx)
+    y = mx.nd.array(np.random.randint(0, 10, (32,)), ctx=ctx)
+    with autograd.record():
+        L = lossf(net(x), y).mean()
+    L.backward(); mx.nd.waitall()
+    log("dense backward ok")
+    # conv only, no pooling
+    cnet = nn.HybridSequential()
+    with cnet.name_scope():
+        cnet.add(nn.Conv2D(16, 3), nn.Flatten(), nn.Dense(10))
+    cnet.initialize(); cnet.hybridize()
+    xi = mx.nd.array(np.random.randn(8, 1, 12, 12).astype('float32'), ctx=ctx)
+    yi = mx.nd.array(np.random.randint(0, 10, (8,)), ctx=ctx)
+    with autograd.record():
+        L = lossf(cnet(xi), yi).mean()
+    log("conv fwd ok")
+    L.backward(); mx.nd.waitall()
+    log("conv backward ok")
+    # now with maxpool
+    pnet = nn.HybridSequential()
+    with pnet.name_scope():
+        pnet.add(nn.Conv2D(16, 3), nn.MaxPool2D(), nn.Flatten(), nn.Dense(10))
+    pnet.initialize(); pnet.hybridize()
+    with autograd.record():
+        L = lossf(pnet(xi), yi).mean()
+    log("pool fwd ok")
+    L.backward(); mx.nd.waitall()
+    log("pool backward ok")

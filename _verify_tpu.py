@@ -1,0 +1,38 @@
+import numpy as np, time
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+
+t0=time.time()
+def log(*a): print(f"[{time.time()-t0:6.1f}s]", *a, flush=True)
+ctx = mx.tpu()
+log("device:", ctx.jax_device())
+mx.random.seed(0); np.random.seed(0)
+with ctx:
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(16, 3, activation='relu'), nn.MaxPool2D(),
+                nn.Flatten(), nn.Dense(64, activation='relu'), nn.Dense(10))
+    net.initialize(init='xavier')
+    net.hybridize()
+    log("net initialized")
+    x = mx.nd.array(np.random.randn(32, 1, 28, 28).astype('float32'), ctx=ctx)
+    y = mx.nd.array(np.random.randint(0, 10, (32,)), ctx=ctx)
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), 'adam', {'learning_rate': 1e-3})
+    losses = []
+    for i in range(10):
+        with autograd.record():
+            L = lossf(net(x), y).mean()
+        L.backward(); tr.step(1); losses.append(float(L.asnumpy()))
+        log(f"step {i} loss {losses[-1]:.3f}")
+    assert losses[-1] < losses[0]
+    m = mx.metric.Accuracy(); m.update(y, net(x))
+    log("accuracy after 10 steps:", m.get())
+    from mxnet_tpu.test_utils import check_consistency
+    check_consistency(lambda a, b: mx.nd.dot(a, b),
+                      [np.random.randn(64, 64).astype('float32'),
+                       np.random.randn(64, 64).astype('float32')],
+                      ctx_list=[mx.cpu(), mx.tpu()])
+    log("cpu<->tpu dot consistency ok")
+    log("VERIFY PASS")

@@ -20,6 +20,20 @@ Layer map of this package vs the reference (SURVEY §1/§7.1):
 
 __version__ = "0.1.0"
 
+from . import config  # noqa: F401
+
+
+def _apply_matmul_precision():
+    # float32 means float32 (MXNet numerics): the XLA default lets f32
+    # dots run in reduced precision; raise it globally unless overridden.
+    prec = config.get("MXNET_TPU_DEFAULT_MATMUL_PRECISION", "highest")
+    if prec and prec != "default":
+        import jax
+        jax.config.update("jax_default_matmul_precision", prec)
+
+
+_apply_matmul_precision()
+
 from .base import MXNetError  # noqa: F401
 from .context import (  # noqa: F401
     Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus,

@@ -1,0 +1,18 @@
+"""gluon — the imperative/hybrid model API (reference python/mxnet/gluon/)."""
+
+from .parameter import Parameter, ParameterDict, Constant  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock, CachedOp  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import data  # noqa: F401
+from . import utils  # noqa: F401
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("rnn", "model_zoo", "contrib"):
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")

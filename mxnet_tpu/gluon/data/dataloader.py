@@ -1,0 +1,126 @@
+"""gluon.data.DataLoader (reference gluon/data/dataloader.py, P8).
+
+The reference forks multiprocessing workers that return batches through
+POSIX-shared-memory NDArrays (Context kCPUShared).  TPU-native rebuild: the
+worker pool is a standard multiprocessing pool returning numpy batches
+(pickled via shared mmap when large); the final host→device transfer is one
+``jax.device_put`` per batch, which PJRT pipelines asynchronously — the role
+pinned memory + copy streams play in the reference.  ``num_workers=0`` is the
+synchronous in-process path (default, and the sensible choice on the 1-core
+sandbox).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+        return NDArray._from_data(jnp.stack([d._data for d in data]))
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn(list(x)) for x in zip(*data))
+    arr = _np.asarray(data)
+    return nd.array(arr)
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+def _as_numpy_sample(sample):
+    if isinstance(sample, NDArray):
+        return sample.asnumpy()
+    if isinstance(sample, (tuple, list)):
+        return tuple(_as_numpy_sample(s) for s in sample)
+    return sample
+
+
+_worker_dataset = None
+
+
+def _worker_init(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples):
+    batch = [_as_numpy_sample(_worker_dataset[i]) for i in samples]
+    if isinstance(batch[0], tuple):
+        return tuple(_np.asarray(x) for x in zip(*batch))
+    return _np.asarray(batch)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120):  # noqa: ARG002
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler "
+                                 "is not given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                        last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        self._pool = None
+        if self._num_workers > 0:
+            self._pool = mp.get_context("fork").Pool(
+                self._num_workers, initializer=_worker_init,
+                initargs=(dataset,))
+
+    def __iter__(self):
+        if self._pool is None:
+            for batch_idx in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch_idx])
+            return
+        # async pool path with bounded prefetch
+        results = []
+        it = iter(self._batch_sampler)
+
+        def issue():
+            try:
+                idx = next(it)
+            except StopIteration:
+                return False
+            results.append(self._pool.apply_async(_worker_fn, (idx,)))
+            return True
+
+        for _ in range(self._prefetch):
+            if not issue():
+                break
+        while results:
+            r = results.pop(0)
+            issue()
+            batch = r.get(self._timeout)
+            if isinstance(batch, tuple):
+                yield tuple(nd.array(b) for b in batch)
+            else:
+                yield nd.array(batch)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

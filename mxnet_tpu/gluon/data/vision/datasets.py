@@ -1,0 +1,191 @@
+"""gluon.data.vision datasets (reference gluon/data/vision/datasets.py):
+MNIST / FashionMNIST / CIFAR10 / CIFAR100 / ImageRecordDataset /
+ImageFolderDataset.
+
+This environment has no network egress, so datasets read from a local root
+only (standard file formats: idx-ubyte for MNIST, python pickle batches for
+CIFAR); a missing root raises with a clear message instead of downloading.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as _np
+
+from ....base import MXNetError
+from ... import data as _data  # noqa: F401
+from ..dataset import Dataset, ArrayDataset
+
+
+def _open_maybe_gz(path):
+    if os.path.exists(path):
+        return open(path, "rb")
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rb")
+    raise FileNotFoundError(path)
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._root = os.path.expanduser(root)
+        self._transform = transform
+        self._data = None
+        self._label = None
+        if not os.path.isdir(self._root):
+            raise MXNetError(
+                f"dataset root {self._root} does not exist; this build has "
+                "no network egress — place the dataset files there manually")
+        self._get_data()
+
+    def __getitem__(self, idx):
+        from .... import ndarray as nd
+        x = nd.array(self._data[idx])
+        y = self._label[idx]
+        if self._transform is not None:
+            return self._transform(x, y)
+        return x, y
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """reference gluon/data/vision/datasets.py :: MNIST (idx-ubyte files)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        self._train_data = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+        self._test_data = ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        images, labels = self._train_data if self._train else self._test_data
+        with _open_maybe_gz(os.path.join(self._root, labels)) as f:
+            struct.unpack(">II", f.read(8))
+            self._label = _np.frombuffer(f.read(), dtype=_np.uint8) \
+                .astype(_np.int32)
+        with _open_maybe_gz(os.path.join(self._root, images)) as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = _np.frombuffer(f.read(), dtype=_np.uint8)
+            self._data = data.reshape(n, rows, cols, 1)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _batches(self):
+        if self._train:
+            return [f"data_batch_{i}" for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _get_data(self):
+        # accepts both the python-pickle layout (cifar-10-batches-py) and a
+        # flat root containing the batch files
+        base = self._root
+        sub = os.path.join(base, "cifar-10-batches-py")
+        if os.path.isdir(sub):
+            base = sub
+        data, labels = [], []
+        for b in self._batches():
+            with open(os.path.join(base, b), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            data.append(d[b"data"])
+            labels.extend(d[b"labels"])
+        data = _np.concatenate(data).reshape(-1, 3, 32, 32)
+        self._data = data.transpose(0, 2, 3, 1)  # HWC like the reference
+        self._label = _np.asarray(labels, dtype=_np.int32)
+
+
+class CIFAR100(_DownloadedDataset):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._train = train
+        self._fine = fine_label
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        base = self._root
+        sub = os.path.join(base, "cifar-100-python")
+        if os.path.isdir(sub):
+            base = sub
+        fname = "train" if self._train else "test"
+        with open(os.path.join(base, fname), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        data = d[b"data"].reshape(-1, 3, 32, 32)
+        self._data = data.transpose(0, 2, 3, 1)
+        key = b"fine_labels" if self._fine else b"coarse_labels"
+        self._label = _np.asarray(d[key], dtype=_np.int32)
+
+
+class ImageRecordDataset(Dataset):
+    """Images + labels from a RecordIO pack (reference ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ..dataset import RecordFileDataset
+        self._record = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._record)
+
+    def __getitem__(self, idx):
+        from .... import recordio, image
+        raw = self._record[idx]
+        header, img_bytes = recordio.unpack(raw)
+        img = image.imdecode(img_bytes, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """folder/label_name/*.jpg layout (reference ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = {".jpg", ".jpeg", ".png", ".bmp"}
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if os.path.splitext(fname)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from .... import image
+        fname, label = self.items[idx]
+        img = image.imread(fname, self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

@@ -1,0 +1,220 @@
+"""gluon.data.vision.transforms (reference gluon/data/vision/transforms.py):
+Compose, Cast, ToTensor, Normalize, Resize, CenterCrop, RandomResizedCrop,
+RandomFlipLeftRight/TopBottom, RandomBrightness/Contrast/Saturation/Hue/
+ColorJitter, RandomLighting."""
+
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+from .... import ndarray as nd
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomHue", "RandomColorJitter", "RandomLighting", "RandomGray"]
+
+
+class Compose(Sequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1]."""
+
+    def hybrid_forward(self, F, x):
+        x = F.cast(x, dtype="float32") / 255.0
+        if x.ndim == 3:
+            return x.transpose((2, 0, 1))
+        return x.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        mean = _np.asarray(self._mean, dtype=_np.float32).reshape(-1, 1, 1)
+        std = _np.asarray(self._std, dtype=_np.float32).reshape(-1, 1, 1)
+        return (x - nd.array(mean, ctx=x.ctx)) / nd.array(std, ctx=x.ctx)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from .... import image
+        if isinstance(self._size, int):
+            if self._keep:
+                h, w = x.shape[0], x.shape[1]
+                if w < h:
+                    size = (self._size, int(h * self._size / w))
+                else:
+                    size = (int(w * self._size / h), self._size)
+            else:
+                size = (self._size, self._size)
+        else:
+            size = self._size
+        return image.imresize(x, size[0], size[1], self._interpolation)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from .... import image
+        return image.center_crop(x, self._size, self._interpolation)[0]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from .... import image
+        return image.random_size_crop(x, self._size, self._scale, self._ratio,
+                                      self._interpolation)[0]
+
+
+class _RandomFlip(Block):
+    axis = 1
+
+    def forward(self, x):
+        if _pyrandom.random() < 0.5:
+            return x.flip(axis=self.axis)
+        return x
+
+
+class RandomFlipLeftRight(_RandomFlip):
+    axis = 1
+
+
+class RandomFlipTopBottom(_RandomFlip):
+    axis = 0
+
+
+class _RandomJitter(Block):
+    def __init__(self, amount):
+        super().__init__()
+        self._amount = amount
+
+    def _factor(self):
+        return 1.0 + _pyrandom.uniform(-self._amount, self._amount)
+
+
+class RandomBrightness(_RandomJitter):
+    def forward(self, x):
+        return (x * self._factor()).clip(0, 255 if x.dtype == _np.uint8
+                                         else 1e30)
+
+
+class RandomContrast(_RandomJitter):
+    def forward(self, x):
+        f = self._factor()
+        mean = x.astype("float32").mean()
+        return (x.astype("float32") * f + mean * (1 - f))
+
+
+class RandomSaturation(_RandomJitter):
+    def forward(self, x):
+        f = self._factor()
+        coef = nd.array(_np.array([0.299, 0.587, 0.114],
+                                  dtype=_np.float32).reshape(1, 1, 3))
+        gray = (x.astype("float32") * coef).sum(axis=2, keepdims=True)
+        return x.astype("float32") * f + gray * (1 - f)
+
+
+class RandomHue(_RandomJitter):
+    def forward(self, x):
+        # simplified hue rotation in YIQ space (reference uses the same trick)
+        f = _pyrandom.uniform(-self._amount, self._amount)
+        u, w = _np.cos(f * _np.pi), _np.sin(f * _np.pi)
+        t_yiq = _np.array([[0.299, 0.587, 0.114], [0.596, -0.274, -0.321],
+                           [0.211, -0.523, 0.311]], dtype=_np.float32)
+        t_rgb = _np.array([[1, 0.956, 0.621], [1, -0.272, -0.647],
+                           [1, -1.107, 1.705]], dtype=_np.float32)
+        rot = _np.array([[1, 0, 0], [0, u, -w], [0, w, u]], dtype=_np.float32)
+        m = t_rgb.dot(rot).dot(t_yiq).T
+        return x.astype("float32").dot(nd.array(m))
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        ts = list(self._ts)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            x = t(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise."""
+    _eigval = _np.array([55.46, 4.794, 1.148], dtype=_np.float32)
+    _eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], dtype=_np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = _np.random.normal(0, self._alpha, size=(3,)).astype(_np.float32)
+        rgb = (self._eigvec * a * self._eigval).sum(axis=1)
+        return x.astype("float32") + nd.array(rgb)
+
+
+class RandomGray(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _pyrandom.random() < self._p:
+            coef = nd.array(_np.array([0.299, 0.587, 0.114],
+                                      dtype=_np.float32).reshape(1, 1, 3))
+            gray = (x.astype("float32") * coef).sum(axis=2, keepdims=True)
+            return gray.tile((1, 1, 3))
+        return x

@@ -1,0 +1,353 @@
+"""gluon.Parameter / ParameterDict.
+
+Rebuild of python/mxnet/gluon/parameter.py (P6): deferred allocation (shapes
+with unknown dims resolved at first forward), per-context data, grad_req,
+lr_mult/wd_mult, save/load.  TPU-native deltas:
+ - one canonical buffer per Parameter (an NDArray over a jax.Array) instead of
+   per-GPU copies; single-process multi-device data parallelism replicates /
+   shards that one buffer via jax.sharding (see mxnet_tpu.parallel), so
+   ``_reduce`` of per-ctx grads becomes an XLA collective, not a host loop.
+ - an optional ``sharding`` hint (a PartitionSpec-like tuple) consumed by the
+   parallel trainer for TP/FSDP layouts.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype=_np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default",
+                 sharding=None):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self.stype = stype
+        self.grad_stype = grad_stype
+        self.sharding = sharding  # TPU: PartitionSpec axes hint for pjit
+        self._data = None
+        self._grad = None
+        self._ctx_list = None
+        self._deferred_init = None
+        self._trainer = None
+
+    # -- state ---------------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data.grad_req = "null"
+                self._data._grad = None
+                self._grad = None
+            else:
+                self._init_grad()
+
+    def _shape_complete(self):
+        return (self.shape is not None and len(self.shape) > 0
+                and all(s > 0 for s in self.shape))
+
+    # -- initialization ------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        from .. import initializer as _initmod
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if not isinstance(ctx, (list, tuple)):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        init = init if init is not None else self.init
+        if default_init is None:
+            default_init = _initmod.Uniform()
+        if not self._shape_complete():
+            if not self.allow_deferred_init:
+                raise MXNetError(
+                    f"Cannot initialize Parameter {self.name!r}: shape "
+                    f"{self.shape} is incomplete and deferred init is off")
+            self._deferred_init = (init, default_init)
+            return
+        self._finish_init(init, default_init)
+
+    def _finish_init(self, init, default_init):
+        from .. import initializer as _initmod
+        data = nd.zeros(self.shape, dtype=self.dtype, ctx=self._ctx_list[0])
+        initializer = init if init is not None else default_init
+        if isinstance(initializer, str):
+            initializer = _initmod.get(initializer)
+        desc = _initmod.InitDesc(self.name)
+        initializer(desc, data)
+        self._data = data
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        self._data.attach_grad(grad_req=self._grad_req)
+        self._grad = self._data._grad
+
+    def _finish_deferred_init(self, in_shape=None):
+        """Called by layers at first forward once input shape is known."""
+        if self._deferred_init is None:
+            return
+        if in_shape is not None:
+            self.shape = tuple(in_shape)
+        if not self._shape_complete():
+            raise DeferredInitializationError(
+                f"Parameter {self.name!r} deferred init could not infer a "
+                f"complete shape (got {self.shape})")
+        init, default_init = self._deferred_init
+        self._finish_init(init, default_init)
+
+    def shape_mismatch_update(self, new_shape):
+        """Merge inferred dims into a partially-known shape."""
+        if self.shape is None:
+            self.shape = tuple(new_shape)
+            return
+        merged = []
+        for old, new in zip(self.shape, new_shape):
+            if old in (0, -1, None):
+                merged.append(new)
+            elif new in (0, -1, None) or old == new:
+                merged.append(old)
+            else:
+                raise MXNetError(
+                    f"Parameter {self.name!r}: inferred shape {new_shape} "
+                    f"incompatible with declared {self.shape}")
+        self.shape = tuple(merged)
+
+    # -- access --------------------------------------------------------------
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init is not None:
+            raise DeferredInitializationError(
+                f"Parameter {self.name!r} has deferred initialization pending "
+                "— run a forward pass first or set the input shape")
+        raise MXNetError(
+            f"Parameter {self.name!r} has not been initialized. Call "
+            ".initialize() first")
+
+    def data(self, ctx=None):  # noqa: ARG002 - one canonical buffer on TPU
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data]
+
+    def grad(self, ctx=None):  # noqa: ARG002
+        self._check_initialized()
+        if self._data._grad is None:
+            raise MXNetError(f"Parameter {self.name!r} has grad_req='null'")
+        return self._data._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        return list(self._ctx_list or [])
+
+    def set_data(self, data):
+        if self._data is None:
+            # loading into an uninitialized/deferred parameter allocates it
+            # directly from the data (reference load_parameters semantics)
+            self.shape = tuple(data.shape)
+            if self._ctx_list is None:
+                self._ctx_list = [current_context()]
+            src = data if isinstance(data, NDArray) else nd.array(data)
+            self._data = NDArray._from_data(
+                src.astype(self.dtype)._data, ctx=self._ctx_list[0])
+            self._deferred_init = None
+            if self._grad_req != "null":
+                self._init_grad()
+            return
+        self._check_initialized()
+        if isinstance(data, NDArray):
+            self._data._set_data(data.astype(self.dtype)._data)
+        else:
+            self._data._set_data(
+                nd.array(data, dtype=self.dtype, ctx=self._ctx_list[0])._data)
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            self._data._grad._set_data(
+                nd.zeros(self.shape, dtype=self.dtype)._data)
+
+    def reset_ctx(self, ctx):
+        if not isinstance(ctx, (list, tuple)):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx[0])
+            if self._grad_req != "null":
+                self._init_grad()
+
+    def cast(self, dtype):
+        self.dtype = _np.dtype(dtype)
+        if self._data is not None:
+            self._data = self._data.astype(dtype)
+            if self._grad_req != "null":
+                self._init_grad()
+
+    def var(self):
+        from .. import symbol as sym
+        return sym.var(self.name, shape=self.shape, dtype=self.dtype)
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+
+class Constant(Parameter):
+    """A non-trainable parameter holding a fixed value (reference
+    gluon/parameter.py :: Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = nd.array(value)
+        self.value = value
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init="__constant__")
+
+    def _finish_init(self, init, default_init):  # noqa: ARG002
+        self._data = self.value.copy()
+        self._deferred_init = None
+
+
+class ParameterDict:
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __iter__(self):
+        return iter(self._params.values())
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __contains__(self, name):
+        return name in self._params
+
+    def __getitem__(self, name):
+        return self._params[name]
+
+    def get(self, name, **kwargs):
+        """Create-or-retrieve (reference semantics incl. shared lookup)."""
+        full = self._prefix + name
+        if full in self._params:
+            p = self._params[full]
+            for k, v in kwargs.items():
+                if v is not None and getattr(p, k, None) in (None, 0, ()):
+                    setattr(p, k, v)
+            return p
+        if self._shared is not None and full in self._shared:
+            self._params[full] = self._shared[full]
+            return self._params[full]
+        p = Parameter(full, **kwargs)
+        self._params[full] = p
+        return p
+
+    def get_constant(self, name, value=None):
+        full = self._prefix + name
+        if full not in self._params:
+            self._params[full] = Constant(full, value)
+        return self._params[full]
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"duplicate parameter name {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):  # noqa: ARG002
+        for p in self.values():
+            p.initialize(init=init, ctx=ctx, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def select(self, pattern):
+        """Regex-select a subset (reference collect_params('.*weight'))."""
+        pat = re.compile(pattern)
+        out = ParameterDict(self._prefix)
+        for k, v in self.items():
+            if pat.match(k):
+                out._params[k] = v
+        return out
+
+    def save(self, filename, strip_prefix=""):
+        arg = {}
+        for k, p in self.items():
+            key = k[len(strip_prefix):] if k.startswith(strip_prefix) else k
+            arg[key] = p.data()
+        nd.save(filename, arg)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        loaded = nd.load(filename, ctx=ctx)
+        if restore_prefix:
+            loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for k, p in self.items():
+            if k in loaded:
+                p.set_data(loaded[k])
+            elif not allow_missing:
+                raise MXNetError(f"Parameter {k} missing in file {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self.keys())
+            if extra:
+                raise MXNetError(
+                    f"File {filename} contains extra parameters: {sorted(extra)}")
+
+    def __repr__(self):
+        lines = "\n".join(f"  {v}" for v in self.values())
+        return f"ParameterDict (\n{lines}\n)"

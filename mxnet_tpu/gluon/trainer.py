@@ -1,0 +1,129 @@
+"""gluon.Trainer (reference python/mxnet/gluon/trainer.py, P6).
+
+API parity: Trainer(params, optimizer, optimizer_params, kvstore,
+update_on_kvstore), ``step(batch_size)``, ``allreduce_grads()``, ``update()``,
+``save_states/load_states``, ``learning_rate`` property.
+
+TPU-native: with kvstore='device'/'local' on one process the gradient
+reduction is an XLA psum over the data-parallel mesh axis (or a no-op on a
+single chip); with 'dist_tpu_sync' the psum spans hosts over ICI/DCN (see
+mxnet_tpu.kvstore).  The optimizer always runs on device (the reference moves
+it to the PS server in dist mode — here the server role does not exist for
+dense training, SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import optimizer as opt
+from .parameter import ParameterDict, Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):  # noqa: ARG002
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("first argument must be a list/dict of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p}")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+            p._trainer = self
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params and set(optimizer_params) - {"rescale_grad"}:
+                raise MXNetError(
+                    "optimizer_params must be None when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        if self._kv_initialized:
+            return
+        kvt = self._kvstore_type
+        if kvt is None or kvt is False:
+            self._kvstore = None
+        elif isinstance(kvt, str):
+            from .. import kvstore as kvs
+            if kvt in ("local", "device", "nccl") and kvs.num_data_devices() <= 1:
+                self._kvstore = None  # single device: reduction is identity
+            else:
+                self._kvstore = kvs.create(kvt)
+        else:
+            self._kvstore = kvt
+        if self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """rescale by 1/batch_size, allreduce, update (reference flow)."""
+        self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                self._kvstore.push(i, p.grad())
+                self._kvstore.pull(i, p.grad())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):  # noqa: ARG002
+        updater = self._updaters[0]
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            updater(i, p.grad(), p.data())
+
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states())
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updaters[0].set_states(f.read())

@@ -1,0 +1,82 @@
+"""gluon.utils (reference python/mxnet/gluon/utils.py): split_and_load,
+clip_global_norm, download (gated — no egress in this environment), check_sha1."""
+
+from __future__ import annotations
+
+import os
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so the joint L2 norm ≤ max_norm (returns the norm)."""
+    import jax.numpy as jnp
+    import math
+    total = None
+    for a in arrays:
+        s = jnp.sum(jnp.square(a._data))
+        total = s if total is None else total + s
+    norm = float(jnp.sqrt(total))
+    if check_isfinite and not math.isfinite(norm):
+        import warnings
+        warnings.warn("nan or inf found in clip_global_norm", stacklevel=2)
+    scale = max_norm / (norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data(a._data * scale)
+    return norm
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):  # noqa: ARG001
+    """Reference API; this environment has no network egress, so only a
+    local cache hit can succeed."""
+    fname = path if path and not os.path.isdir(path) else \
+        os.path.join(path or ".", url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite and \
+            (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    raise MXNetError(
+        f"cannot download {url}: network egress is unavailable in this "
+        f"environment and {fname} is not cached locally")

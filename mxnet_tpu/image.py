@@ -1,0 +1,314 @@
+"""mx.image — image IO + augmentation (reference python/mxnet/image/image.py,
+P15, and src/operator/image/ GPU ops).
+
+imdecode/imread/imresize/crops run on host via cv2 (the reference's CPU path);
+the normalized float path then moves to device once per batch.  ImageIter is
+the python-side augmentation pipeline over RecordIO or image lists.
+"""
+
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+
+import numpy as _np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "random_size_crop", "color_normalize",
+           "HorizontalFlipAug", "CastAug", "ColorNormalizeAug", "ResizeAug",
+           "ForceResizeAug", "RandomCropAug", "CenterCropAug", "CreateAugmenter",
+           "ImageIter"]
+
+
+def _cv2():
+    import cv2
+    return cv2
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):  # noqa: ARG001
+    cv2 = _cv2()
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().astype(_np.uint8)
+    img = cv2.imdecode(_np.frombuffer(bytes(buf), _np.uint8),
+                       cv2.IMREAD_COLOR if flag else cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError("imdecode failed")
+    if flag and to_rgb:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if not flag:
+        img = img[:, :, None]
+    return nd.array(img.astype(_np.uint8), dtype=_np.uint8)
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    cv2 = _cv2()
+    interps = {0: cv2.INTER_NEAREST, 1: cv2.INTER_LINEAR, 2: cv2.INTER_CUBIC,
+               3: cv2.INTER_AREA, 4: cv2.INTER_LANCZOS4}
+    img = src.asnumpy() if isinstance(src, NDArray) else src
+    out = cv2.resize(img, (w, h), interpolation=interps.get(interp,
+                                                            cv2.INTER_LINEAR))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out, dtype=out.dtype)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    elif isinstance(out, NDArray) and out._base is not None:
+        out = NDArray._from_data(out._data, ctx=out.ctx)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(*area) * src_area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        new_ratio = _np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(_np.sqrt(target_area * new_ratio)))
+        new_h = int(round(_np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype(_np.float32) if src.dtype == _np.uint8 else src
+    out = src - mean
+    if std is not None:
+        out = out / std
+    return out
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return src.flip(axis=1)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ=_np.float32):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = nd.array(mean) if not isinstance(mean, NDArray) else mean
+        self.std = nd.array(std) if std is not None and \
+            not isinstance(std, NDArray) else std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):  # noqa: ARG001
+    """reference image.py :: CreateAugmenter — standard pipeline builder."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(Augmenter())  # placeholder replaced below
+        auglist[-1] = type("RandomSizedCropAug", (Augmenter,), {
+            "__call__": lambda self, src:
+                random_size_crop(src, crop_size, (0.08, 1.0),
+                                 (3 / 4, 4 / 3), inter_method)[0]})()
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and not isinstance(mean, bool):
+        auglist.append(ColorNormalizeAug(_np.asarray(mean),
+                                         _np.asarray(std)
+                                         if std is not None else None))
+    return auglist
+
+
+class ImageIter:
+    """Python-side augmenting iterator over .rec or .lst (reference
+    image.py :: ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, imglist=None, **kwargs):
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **kwargs)
+        self.shuffle = shuffle
+        self._rec = None
+        self.imglist = []
+        if path_imgrec:
+            from . import recordio
+            idx = os.path.splitext(path_imgrec)[0] + ".idx"
+            self._rec = recordio.MXIndexedRecordIO(idx, path_imgrec, "r")
+            self.seq = list(self._rec.keys)
+        elif path_imglist or imglist is not None:
+            if path_imglist:
+                with open(path_imglist) as fin:
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        label = _np.asarray(parts[1:1 + label_width],
+                                            dtype=_np.float32)
+                        self.imglist.append(
+                            (label, os.path.join(path_root, parts[-1])))
+            else:
+                for item in imglist:
+                    self.imglist.append(
+                        (_np.asarray(item[:-1], _np.float32),
+                         os.path.join(path_root, item[-1])))
+            self.seq = list(range(len(self.imglist)))
+        else:
+            raise MXNetError("need path_imgrec, path_imglist or imglist")
+        self.cur = 0
+        self.reset()
+
+    def reset(self):
+        self.cur = 0
+        if self.shuffle:
+            _pyrandom.shuffle(self.seq)
+
+    def next_sample(self):
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self._rec is not None:
+            from . import recordio
+            header, img_bytes = recordio.unpack(self._rec.read_idx(idx))
+            return header.label, imdecode(img_bytes)
+        label, fname = self.imglist[idx]
+        return label, imread(fname)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from .io.io import DataBatch
+        c, h, w = self.data_shape
+        batch_data = _np.zeros((self.batch_size, c, h, w), _np.float32)
+        batch_label = _np.zeros((self.batch_size, self.label_width),
+                                _np.float32)
+        i = 0
+        while i < self.batch_size:
+            label, img = self.next_sample()
+            for aug in self.auglist:
+                img = aug(img)
+            arr = img.asnumpy() if isinstance(img, NDArray) else img
+            batch_data[i] = arr.transpose(2, 0, 1)
+            batch_label[i] = label
+            i += 1
+        return DataBatch([nd.array(batch_data)],
+                         [nd.array(batch_label.squeeze(-1)
+                                   if self.label_width == 1 else batch_label)],
+                         pad=0)
+
+    next = __next__

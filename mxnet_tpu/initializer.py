@@ -1,0 +1,248 @@
+"""Weight initializers (reference python/mxnet/initializer.py, P21).
+
+API parity: registry + string lookup (``init='xavier'``), ``InitDesc`` name-
+pattern dispatch (arrays named *_bias get zeros, *gamma ones, ...), the
+standard zoo: Uniform/Normal/Constant/Zero/One/Orthogonal/Xavier/MSRAPrelu/
+Bilinear/LSTMBias.  Draws go through the stateful RNG facade so
+``mx.random.seed`` controls them.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def get(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise MXNetError(f"unknown initializer {name!r}; "
+                         f"known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key](**kwargs)
+
+
+class InitDesc(str):
+    """Array-name descriptor carrying init attrs (reference InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        s = super().__new__(cls, name)
+        s.attrs = attrs or {}
+        s.global_init = global_init
+        return s
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(desc)
+        init_attr = desc.attrs.get("__init__", "")
+        if init_attr:
+            get(init_attr)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_one(desc, arr)
+        elif name.endswith("beta"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_weight(desc, arr)
+
+    init_weight = __call__
+
+    def _init_bias(self, desc, arr):
+        self._init_zero(desc, arr)
+
+    def _init_zero(self, desc, arr):  # noqa: ARG002
+        arr[:] = 0.0
+
+    def _init_one(self, desc, arr):  # noqa: ARG002
+        arr[:] = 1.0
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _rand(self, kind, arr, **kw):
+        import jax
+        from . import random as _rnd
+        key = _rnd.get_key(arr.ctx)
+        if kind == "uniform":
+            val = jax.random.uniform(key, arr.shape, arr.dtype,
+                                     minval=kw["low"], maxval=kw["high"])
+        else:
+            val = jax.random.normal(key, arr.shape, arr.dtype) * kw["sigma"]
+        arr._set_data(val)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 0.0
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        self._rand("uniform", arr, low=-self.scale, high=self.scale)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        self._rand("normal", arr, sigma=self.sigma)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        import jax
+        from . import random as _rnd
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        key = _rnd.get_key(arr.ctx)
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(key, (nout, nin), minval=-1.0, maxval=1.0)
+        else:
+            tmp = jax.random.normal(key, (nout, nin))
+        u, _, v = _np.linalg.svd(_np.asarray(tmp), full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr[:] = _np.asarray(self.scale * q.reshape(arr.shape), dtype=arr.dtype)
+
+
+def _fan(shape, factor_type):
+    hw = int(_np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+    fan_out = shape[0] * hw
+    if factor_type == "avg":
+        return (fan_in + fan_out) / 2.0
+    if factor_type == "in":
+        return fan_in
+    return fan_out
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        factor = _fan(arr.shape, self.factor_type)
+        scale = _np.sqrt(self.magnitude / max(factor, 1.0))
+        if self.rnd_type == "uniform":
+            self._rand("uniform", arr, low=-scale, high=scale)
+        else:
+            self._rand("normal", arr, sigma=scale)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, desc, arr):
+        weight = _np.zeros(int(_np.prod(arr.shape)), dtype=_np.float32)
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(weight.size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = _np.asarray(weight.reshape(shape), dtype=arr.dtype)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias 1.0, everything else 0 (gate order i,f,g,o)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        v = _np.zeros(arr.shape, dtype=_np.float32)
+        n = arr.shape[0] // 4
+        v[n:2 * n] = self.forget_bias
+        arr[:] = _np.asarray(v, dtype=arr.dtype)
+
+
+@register
+class Mixed(Initializer):
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        import re
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, desc, arr):
+        for pat, init in self.map:
+            if pat.match(desc):
+                init(desc, arr)
+                return
+        raise MXNetError(f"no initializer pattern matched {desc!r}; "
+                         "add a '.*' catch-all")
+
+
+# string aliases the reference accepts
+_REGISTRY["zeros"] = Zero
+_REGISTRY["ones"] = One
+_REGISTRY["msra_prelu"] = MSRAPrelu
+_REGISTRY["gaussian"] = Normal

@@ -1,0 +1,8 @@
+"""mx.io — legacy DataIter API (reference python/mxnet/io/io.py, P14; C++
+iterators src/io/ N19 are covered by the RecordIO-backed iterators here +
+gluon.data for the modern path)."""
+
+from .io import (  # noqa: F401
+    DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
+    CSVIter, MNISTIter, ImageRecordIter, LibSVMIter,
+)

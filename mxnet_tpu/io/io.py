@@ -1,0 +1,483 @@
+"""Legacy DataIter stack.
+
+Rebuild of python/mxnet/io/io.py (DataIter/DataBatch/DataDesc/NDArrayIter/
+ResizeIter/PrefetchingIter) plus Python-side equivalents of the C++ iterators
+in src/io/ (N19): CSVIter, MNISTIter, LibSVMIter, ImageRecordIter.  The C++
+iterators' contract is preserved — `part_index`/`num_parts` sharding (how
+distributed data sharding happens, SURVEY §3.5), `provide_data/provide_label`,
+batch padding semantics — while decode runs through numpy/cv2 worker threads
+feeding one async device_put per batch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import queue as _queue
+from collections import namedtuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "LibSVMIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        return (f"DataBatch(data={[d.shape for d in self.data]}, "
+                f"label={[l.shape for l in (self.label or [])]}, "
+                f"pad={self.pad})")
+
+
+class DataIter:
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    __next__ = next
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data cannot be None")
+        return []
+    if isinstance(data, (NDArray, _np.ndarray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        data = {f"{default_name}{'_%d' % i if i else ''}": d
+                for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        if not isinstance(v, NDArray):
+            v = nd.array(_np.asarray(v))
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """reference io.py :: NDArrayIter — batching with pad/discard/roll_over."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._cache_idx = _np.arange(self.num_data)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            _np.random.shuffle(self._cache_idx)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, arrays):
+        end = self.cursor + self.batch_size
+        idx = self._cache_idx
+        out = []
+        for _, v in arrays:
+            a = v.asnumpy()
+            if end <= self.num_data:
+                sel = a[idx[self.cursor:end]]
+            else:  # pad by wrapping (reference 'pad' behavior)
+                first = a[idx[self.cursor:]]
+                rest = a[idx[:end - self.num_data]]
+                sel = _np.concatenate([first, rest])
+            out.append(nd.array(sel, dtype=sel.dtype))
+        return out
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches per epoch."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    __next__ = next
+
+
+class PrefetchingIter(DataIter):
+    """Thread-prefetching wrapper (reference PrefetchingIter; the role of
+    dmlc::ThreadedIter in the C++ pipeline)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):  # noqa: ARG002
+        if not isinstance(iters, list):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self._queue = _queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        return sum([i.provide_data for i in self.iters], [])
+
+    @property
+    def provide_label(self):
+        return sum([i.provide_label for i in self.iters], [])
+
+    def _start(self):
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    batches = [i.next() for i in self.iters]
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batches)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for i in self.iters:
+            i.reset()
+        self._stop = threading.Event()
+        self._queue = _queue.Queue(maxsize=2)
+        self._start()
+
+    def next(self):
+        batches = self._queue.get()
+        if batches is None:
+            raise StopIteration
+        b = batches[0]
+        if len(batches) > 1:
+            b = DataBatch(sum([x.data for x in batches], []),
+                          sum([x.label or [] for x in batches], []),
+                          pad=batches[0].pad)
+        return b
+
+    __next__ = next
+
+    def iter_next(self):
+        raise NotImplementedError
+
+
+class CSVIter(NDArrayIter):
+    """reference src/io/iter_csv.cc — CSV → batches."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype=_np.float32, **kwargs):
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=dtype,
+                           ndmin=2).reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=dtype,
+                                ndmin=2).reshape((-1,) + tuple(label_shape))
+        super().__init__(data, label, batch_size,
+                         last_batch_handle="pad" if round_batch else "discard",
+                         **kwargs)
+
+
+class MNISTIter(NDArrayIter):
+    """reference src/io/iter_mnist.cc — idx-ubyte files → batches."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 part_index=0, num_parts=1, seed=0, **kwargs):  # noqa: ARG002
+        import gzip
+        import struct as _struct
+
+        def opn(p):
+            return gzip.open(p, "rb") if p.endswith(".gz") else open(p, "rb")
+        with opn(label) as f:
+            _struct.unpack(">II", f.read(8))
+            lab = _np.frombuffer(f.read(), dtype=_np.uint8).astype(_np.float32)
+        with opn(image) as f:
+            _, n, r, c = _struct.unpack(">IIII", f.read(16))
+            img = _np.frombuffer(f.read(), dtype=_np.uint8)
+            img = img.reshape(n, 1, r, c).astype(_np.float32) / 255.0
+        if flat:
+            img = img.reshape(n, r * c)
+        # dist sharding contract: part_index/num_parts
+        shard = slice(part_index * n // num_parts,
+                      (part_index + 1) * n // num_parts)
+        super().__init__(img[shard], lab[shard], batch_size, shuffle=shuffle,
+                         **kwargs)
+
+
+class LibSVMIter(DataIter):
+    """reference src/io/iter_libsvm.cc — libsvm text → CSR batches."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 part_index=0, num_parts=1, **kwargs):  # noqa: ARG002
+        super().__init__(batch_size)
+        self._feat_dim = data_shape[0] if isinstance(data_shape, (tuple, list)) \
+            else data_shape
+        rows, labels = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                rows.append({int(k): float(v) for k, v in
+                             (p.split(":") for p in parts[1:])})
+        n = len(rows)
+        shard = slice(part_index * n // num_parts,
+                      (part_index + 1) * n // num_parts)
+        self._rows = rows[shard]
+        self._labels = _np.asarray(labels[shard], dtype=_np.float32)
+        self._cursor = -batch_size
+
+    def reset(self):
+        self._cursor = -self.batch_size
+
+    def iter_next(self):
+        self._cursor += self.batch_size
+        return self._cursor < len(self._rows)
+
+    def getdata(self):
+        from ..ndarray import sparse as sp
+        end = min(self._cursor + self.batch_size, len(self._rows))
+        dense = _np.zeros((self.batch_size, self._feat_dim), _np.float32)
+        for i, r in enumerate(self._rows[self._cursor:end]):
+            for k, v in r.items():
+                if k < self._feat_dim:
+                    dense[i, k] = v
+        return [sp.csr_matrix(dense)]
+
+    def getlabel(self):
+        end = self._cursor + self.batch_size
+        lab = self._labels[self._cursor:end]
+        if len(lab) < self.batch_size:
+            lab = _np.concatenate(
+                [lab, self._labels[:self.batch_size - len(lab)]])
+        return [nd.array(lab)]
+
+    def getpad(self):
+        end = self._cursor + self.batch_size
+        return max(0, end - len(self._rows))
+
+
+class ImageRecordIter(DataIter):
+    """reference src/io/iter_image_recordio_2.cc — the ImageNet pipeline:
+    RecordIO shards + threaded JPEG decode + augmentation + prefetch.
+
+    Supported params mirror the reference's ImageRecordParam/augmenters:
+    data_shape, batch_size, shuffle, rand_crop, rand_mirror, mean_[rgb],
+    std_[rgb], resize, part_index/num_parts (dist sharding).
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size=1, shuffle=False,
+                 rand_crop=False, rand_mirror=False, mean_r=0.0, mean_g=0.0,
+                 mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0, resize=-1,
+                 part_index=0, num_parts=1, preprocess_threads=4,
+                 label_width=1, path_imgidx=None, **kwargs):  # noqa: ARG002
+        super().__init__(batch_size)
+        from .. import recordio
+        self._rec_path = path_imgrec
+        idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
+        if os.path.exists(idx_path):
+            self._rec = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            keys = self._rec.keys
+            shard = keys[part_index::num_parts]
+            self._keys = list(shard)
+        else:
+            raise MXNetError(
+                f"ImageRecordIter requires an index file ({idx_path}); "
+                "create it with tools/im2rec.py")
+        self.data_shape = tuple(data_shape)
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mean = _np.array([mean_r, mean_g, mean_b], _np.float32)
+        self.std = _np.array([std_r, std_g, std_b], _np.float32)
+        self.resize = resize
+        self._order = _np.arange(len(self._keys))
+        self._cursor = -batch_size
+        self._threads = max(1, preprocess_threads)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = -self.batch_size
+        if self.shuffle:
+            _np.random.shuffle(self._order)
+
+    def iter_next(self):
+        self._cursor += self.batch_size
+        return self._cursor + self.batch_size <= len(self._keys)
+
+    def _decode_one(self, key):
+        import cv2
+        from .. import recordio as rio
+        raw = self._rec.read_idx(self._keys[key])
+        header, img_bytes = rio.unpack(raw)
+        img = cv2.imdecode(_np.frombuffer(img_bytes, _np.uint8),
+                           cv2.IMREAD_COLOR)
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        c, h, w = self.data_shape
+        if self.resize > 0:
+            ih, iw = img.shape[:2]
+            if ih < iw:
+                img = cv2.resize(img, (int(iw * self.resize / ih), self.resize))
+            else:
+                img = cv2.resize(img, (self.resize, int(ih * self.resize / iw)))
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            img = cv2.resize(img, (max(w, iw), max(h, ih)))
+            ih, iw = img.shape[:2]
+        if self.rand_crop:
+            y0 = _np.random.randint(0, ih - h + 1)
+            x0 = _np.random.randint(0, iw - w + 1)
+        else:
+            y0, x0 = (ih - h) // 2, (iw - w) // 2
+        img = img[y0:y0 + h, x0:x0 + w]
+        if self.rand_mirror and _np.random.rand() < 0.5:
+            img = img[:, ::-1]
+        img = img.astype(_np.float32)
+        img = (img - self.mean) / self.std
+        label = header.label if _np.isscalar(header.label) \
+            else _np.asarray(header.label).ravel()[0]
+        return img.transpose(2, 0, 1), _np.float32(label)
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        idxs = self._order[self._cursor:self._cursor + self.batch_size]
+        from concurrent.futures import ThreadPoolExecutor
+        if self._threads > 1:
+            with ThreadPoolExecutor(self._threads) as ex:
+                results = list(ex.map(self._decode_one, idxs))
+        else:
+            results = [self._decode_one(i) for i in idxs]
+        imgs = _np.stack([r[0] for r in results])
+        labels = _np.asarray([r[1] for r in results], _np.float32)
+        return DataBatch([nd.array(imgs)], [nd.array(labels)], pad=0)
+
+    __next__ = next

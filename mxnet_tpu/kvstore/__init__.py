@@ -1,0 +1,57 @@
+"""KVStore — the distributed key-value synchronization API.
+
+Rebuild of src/kvstore/* + python/mxnet/kvstore (N12-N17, P13, SURVEY §5.8).
+Semantics preserved: ``mx.kv.create(type)`` factory with named-key
+init/push/pull/pushpull/broadcast/row_sparse_pull, rank/num_workers,
+``set_optimizer`` (update-on-kvstore), ``set_gradient_compression``,
+``_barrier``.
+
+TPU-native mapping (SURVEY §7.1):
+ - 'local' / 'device' / 'nccl' → single-process reduction.  Pushing a LIST of
+   per-device values sums them with one XLA add chain (the CommDevice role);
+   there are no P2P copy trees to manage — ICI routing belongs to XLA.
+ - 'dist_sync' / 'dist_device_sync' / 'dist_tpu_sync' → multi-process
+   ``jax.distributed`` + psum over the global device mesh (see dist.py).  No
+   scheduler/server processes: the DCN bootstrap plays the scheduler role and
+   the optimizer stays on device.
+ - 'dist_async' → documented drop: fully-async SGD has no sane TPU-native
+   analog (SURVEY §7.1 table); creation raises with that explanation.
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+from .base import KVStoreBase  # noqa: F401
+from .local import KVStoreLocal
+from .dist import KVStoreDistTPUSync
+
+
+def num_data_devices():
+    """Devices the data-parallel axis would span in this process."""
+    import jax
+    return jax.local_device_count()
+
+
+def create(name="local", **kwargs):
+    """mx.kv.create — reference src/kvstore/kvstore.cc :: KVStore::Create."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be a string")
+    n = name.lower()
+    if n in ("local", "local_update_cpu", "local_allreduce_cpu",
+             "local_allreduce_device", "device", "nccl"):
+        return KVStoreLocal(name=n)
+    if n in ("dist_sync", "dist_device_sync", "dist_tpu_sync", "dist"):
+        return KVStoreDistTPUSync(name=n, **kwargs)
+    if n in ("dist_async", "dist_sync_device_async"):
+        raise MXNetError(
+            "kvstore 'dist_async' is intentionally unsupported in the TPU "
+            "rebuild: asynchronous parameter-server SGD has no TPU-native "
+            "equivalent (no server processes exist; gradients reduce via "
+            "synchronous XLA collectives). Use 'dist_tpu_sync'.")
+    if n == "horovod":
+        raise MXNetError("horovod backend not available in this build; use "
+                         "'dist_tpu_sync'")
+    raise MXNetError(f"unknown kvstore type {name!r}")
+
+
+KVStore = KVStoreLocal  # handle-style alias

@@ -1,0 +1,39 @@
+"""KVStoreBase — the pluggable backend interface (reference 1.7
+python/mxnet/kvstore/base.py :: KVStoreBase.register)."""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+
+_BACKENDS = {}
+
+
+class KVStoreBase:
+    @staticmethod
+    def register(klass):
+        _BACKENDS[klass.__name__.lower()] = klass
+        return klass
+
+    # capability strings (reference KVStoreBase.OPTIMIZER/...)
+    OPTIMIZER = "optimizer"
+
+    def is_capable(self, capability):
+        return capability == self.OPTIMIZER
+
+    @property
+    def type(self):
+        raise NotImplementedError
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def broadcast(self, key, value, out):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError

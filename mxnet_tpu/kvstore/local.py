@@ -1,0 +1,174 @@
+"""Single-process KVStore ('local' / 'device' / 'nccl').
+
+Rebuild of src/kvstore/kvstore_local.h + comm.h/comm_tree.h/kvstore_nccl.h
+(N12/N15).  The reference's three reduction engines (CPU reduce, GPU P2P,
+PCIe tree, NCCL ring) collapse into one path: summing jax.Arrays, which XLA
+lowers to ICI collectives when the inputs live on different TPU chips.
+Supports dense NDArrays and RowSparseNDArray (sparse merge = concat+segment
+sum; ``row_sparse_pull(row_ids)`` retains only requested rows).
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from ..ndarray import sparse as sp
+from .base import KVStoreBase
+
+
+def _is_list(v):
+    return isinstance(v, (list, tuple))
+
+
+class KVStoreLocal(KVStoreBase):
+    def __init__(self, name="local"):
+        self._type = name
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # -- helpers -------------------------------------------------------------
+    def _reduce(self, values):
+        if not _is_list(values):
+            return values
+        if len(values) == 1:
+            return values[0]
+        if isinstance(values[0], sp.RowSparseNDArray):
+            return self._reduce_rowsparse(values)
+        out = values[0]
+        for v in values[1:]:
+            out = out + v
+        return out
+
+    @staticmethod
+    def _reduce_rowsparse(values):
+        import numpy as np
+        import jax.numpy as jnp
+        idx = np.concatenate([np.asarray(v.indices._data) for v in values])
+        dat = jnp.concatenate([v.data._data for v in values], axis=0)
+        uniq, inv = np.unique(idx, return_inverse=True)
+        import jax
+        merged = jax.ops.segment_sum(dat, jnp.asarray(inv),
+                                     num_segments=len(uniq))
+        return sp.RowSparseNDArray(
+            NDArray._from_data(merged), nd.array(uniq.astype("int64")),
+            values[0].shape, ctx=values[0].ctx, dtype=values[0].dtype)
+
+    # -- API -----------------------------------------------------------------
+    def init(self, key, value):
+        if _is_list(key):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        if key in self._store:
+            raise MXNetError(f"key {key!r} already initialized")
+        v = value[0] if _is_list(value) else value
+        if isinstance(v, sp.BaseSparseNDArray):
+            self._store[key] = v
+        else:
+            self._store[key] = v.copy()
+
+    def push(self, key, value, priority=0):  # noqa: ARG002
+        if _is_list(key) and _is_list(value) and len(key) > 1:
+            for k, v in zip(key, value):
+                self.push(k, v)
+            return
+        if _is_list(key):
+            key = key[0]
+        if key not in self._store:
+            raise MXNetError(f"key {key!r} not initialized")
+        merged = self._reduce(value)
+        if self._updater is not None:
+            self._updater(key, merged, self._store[key])
+        else:
+            stored = self._store[key]
+            if isinstance(merged, sp.BaseSparseNDArray) or \
+                    isinstance(stored, sp.BaseSparseNDArray):
+                self._store[key] = merged
+            else:
+                stored._set_data(merged._data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):  # noqa: ARG002
+        if _is_list(key) and _is_list(out) and len(key) > 1 \
+                and len(key) == len(out):
+            for k, o in zip(key, out):
+                self.pull(k, o)
+            return
+        if _is_list(key):
+            key = key[0]
+        if key not in self._store:
+            raise MXNetError(f"key {key!r} not initialized")
+        stored = self._store[key]
+        outs = out if _is_list(out) else [out]
+        for o in outs:
+            if isinstance(stored, sp.BaseSparseNDArray):
+                dense = stored.tostype("default")
+                o._set_data(dense._data)
+            else:
+                o._set_data(stored._data)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):  # noqa: ARG002
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        if _is_list(key):
+            key = key[0]
+        stored = self._store[key]
+        if not isinstance(stored, sp.RowSparseNDArray):
+            stored = sp.cast_storage(stored, "row_sparse")
+        outs = out if _is_list(out) else [out]
+        rids = row_ids if _is_list(row_ids) else [row_ids] * len(outs)
+        for o, r in zip(outs, rids):
+            ret = stored.retain(r)
+            o.data._set_data(ret.data._data)
+            o.indices._set_data(ret.indices._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def set_optimizer(self, optimizer):
+        """update_on_kvstore path — reference runs this on the PS server; the
+        local store runs it inline at push time."""
+        from .. import optimizer as opt
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = dict(compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on this kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on this kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _barrier(self):
+        nd.waitall()

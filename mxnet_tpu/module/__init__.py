@@ -1,0 +1,4 @@
+"""mx.mod — legacy Module API (reference python/mxnet/module/, P11)."""
+
+from .module import Module, BaseModule  # noqa: F401
+from .bucketing_module import BucketingModule  # noqa: F401

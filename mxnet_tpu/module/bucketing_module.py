@@ -1,0 +1,105 @@
+"""BucketingModule (reference python/mxnet/module/bucketing_module.py):
+variable-length sequence training — one Module per bucket key, params shared.
+
+TPU note: each bucket is a separate XLA specialization (static shapes); the
+reference's shared-memory-pool trick becomes XLA's per-shape executable cache.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .module import BaseModule, Module
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, **kwargs):
+        super().__init__(logger)
+        if default_bucket_key is None:
+            raise MXNetError("default_bucket_key required")
+        self._sym_gen = sym_gen
+        self._default_key = default_bucket_key
+        self._context = context
+        self._kwargs = kwargs
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_key = None
+        self._arg_cache = None
+        self._opt_args = None
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    def _module_for(self, key):
+        if key not in self._buckets:
+            sym, data_names, label_names = self._sym_gen(key)
+            mod = Module(sym, data_names, label_names, self.logger,
+                         self._context, **self._kwargs)
+            self._buckets[key] = mod
+        return self._buckets[key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, **kwargs):  # noqa: ARG002
+        self._curr_module = self._module_for(self._default_key)
+        self._curr_key = self._default_key
+        self._curr_module.bind(data_shapes, label_shapes, for_training,
+                               force_rebind=force_rebind)
+        self.binded = True
+        self.for_training = for_training
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        mod = self._module_for(bucket_key)
+        if not mod.binded:
+            mod.bind(data_shapes, label_shapes, self.for_training)
+            if self.params_initialized and self._curr_module is not None:
+                arg, aux = self._curr_module.get_params()
+                mod.set_params(arg, aux)
+            if self.optimizer_initialized and self._opt_args is not None:
+                mod.init_optimizer(**self._opt_args)
+        else:
+            # sync shared params into the target bucket
+            if self._curr_module is not None and self.params_initialized:
+                arg, aux = self._curr_module.get_params()
+                mod.set_params(arg, aux)
+        self._curr_module = mod
+        self._curr_key = bucket_key
+
+    def init_params(self, **kwargs):
+        self._curr_module.init_params(**kwargs)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def set_params(self, arg_params, aux_params, **kwargs):
+        self._curr_module.set_params(arg_params, aux_params, **kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        self._opt_args = dict(kwargs)
+        self._curr_module.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", self._default_key)
+        if key != self._curr_key:
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+        # propagate updated params back into other bound buckets lazily at
+        # the next switch (set_params in switch_bucket)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels, pre_sliced)

@@ -1,0 +1,293 @@
+"""Module — the legacy symbolic training loop.
+
+Rebuild of python/mxnet/module/{base_module,module,executor_group}.py (P11):
+bind → one Executor (the DataParallelExecutorGroup's batch-splitting role is
+subsumed by the parallel trainer's sharded step on TPU — a single executor
+spans the mesh), init_params/init_optimizer, forward/backward/update,
+fit()/score()/predict(), save_checkpoint/load.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from .. import ndarray as nd
+from .. import metric as _metric
+from .. import optimizer as _opt
+from .. import initializer as _init
+from ..model import BatchEndParam, save_checkpoint, load_params
+
+__all__ = ["BaseModule", "Module"]
+
+
+class BaseModule:
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.for_training = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    # -- high-level loops ----------------------------------------------------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):  # noqa: ARG002
+        if num_epoch is None:
+            raise MXNetError("num_epoch must be specified for fit")
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer or _init.Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params,
+                            force_init=force_init)
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, data_batch in enumerate(train_data):
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if batch_end_callback is not None:
+                    param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                          eval_metric=eval_metric,
+                                          locals=locals())
+                    for cb in _as_list(batch_end_callback):
+                        cb(param)
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if epoch_end_callback is not None:
+                arg, aux = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg, aux)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
+
+    def score(self, eval_data, eval_metric, num_batch=None, reset=True,
+              epoch=0, **kwargs):  # noqa: ARG002
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+        eval_metric.reset()
+        if reset:
+            eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, reset=True):
+        if reset:
+            eval_data.reset()
+        outputs = []
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outputs.append([o.copy() for o in self.get_outputs()])
+        if not outputs:
+            return []
+        import jax.numpy as jnp
+        from ..ndarray.ndarray import NDArray
+        num_out = len(outputs[0])
+        return [NDArray._from_data(
+            jnp.concatenate([b[i]._data for b in outputs], axis=0))
+            for i in range(num_out)]
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    # subclass surface
+    def bind(self, *a, **k):
+        raise NotImplementedError
+
+    def forward(self, *a, **k):
+        raise NotImplementedError
+
+    def backward(self, *a, **k):
+        raise NotImplementedError
+
+    def update(self):
+        raise NotImplementedError
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):  # noqa: ARG002
+        super().__init__(logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context if context is not None else current_context()
+        if isinstance(self._context, (list, tuple)):
+            self._context = self._context[0]  # one executor spans the mesh
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def output_shapes(self):
+        return [(n, o.shape) for n, o in
+                zip(self.output_names, self._exec.outputs)]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):  # noqa: ARG002
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        shapes = {}
+        for desc in list(data_shapes) + list(label_shapes or []):
+            name, shape = desc[0], desc[1]
+            shapes[name] = shape
+        self._exec = self._symbol.simple_bind(
+            ctx=self._context,
+            grad_req=grad_req if for_training else "null", **shapes)
+        self.binded = True
+        self.for_training = for_training
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):  # noqa: ARG002
+        if self.params_initialized and not force_init:
+            return
+        initializer = initializer or _init.Uniform(0.01)
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params and name in arg_params:
+                arr._set_data(arg_params[name]._data)
+            else:
+                initializer(_init.InitDesc(name), arr)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params and name in aux_params:
+                arr._set_data(aux_params[name]._data)
+            else:
+                initializer(_init.InitDesc(name), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init,
+                         allow_extra=allow_extra)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):  # noqa: ARG002
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            optimizer = _opt.create(optimizer, **dict(optimizer_params))
+        self._optimizer = optimizer
+        self._updater = _opt.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                if name in self._exec.arg_dict:
+                    feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads)
+
+    def update(self):
+        for i, name in enumerate(self._param_names):
+            if name in self._fixed_param_names:
+                continue
+            g = self._exec.grad_dict.get(name)
+            if g is None:
+                continue
+            self._updater(i, g, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):  # noqa: ARG002
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):  # noqa: ARG002
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):  # noqa: ARG002
+        eval_metric.update(labels, self.get_outputs())
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states and self._updater is not None:
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..model import load_checkpoint
+        sym, arg, aux = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded_params = (arg, aux)
+        mod._preload_opt_states = f"{prefix}-{epoch:04d}.states" \
+            if load_optimizer_states else None
+        return mod

@@ -715,13 +715,19 @@ def _put(np_arr, ctx):
 
 
 def array(source_array, ctx=None, dtype=None):
+    was_np = isinstance(source_array, (_np.ndarray, NDArray))
     if isinstance(source_array, NDArray):
         src = source_array.asnumpy()
     else:
         src = _np.asarray(source_array)
     if dtype is None:
-        # reference default: python floats land as float32 (mx_real_t)
-        dtype = mx_real_t if src.dtype == _np.float64 else src.dtype
+        # reference default: python lists/scalars land as float32
+        # (mx_real_t); numpy sources keep their dtype except float64
+        if not was_np or src.dtype == _np.float64:
+            dtype = mx_real_t if src.dtype.kind == "f" or not was_np \
+                else src.dtype
+        else:
+            dtype = src.dtype
     src = src.astype(dtype_from_any(dtype), copy=False)
     arr, ctx = _put(src, ctx)
     return NDArray._from_data(arr, ctx=ctx)

@@ -1,0 +1,236 @@
+"""mx.np — NumPy-compatible array API (reference python/mxnet/numpy/, P3).
+
+The reference maintains a parallel ~80k-LoC operator corpus
+(src/operator/numpy/*) mirroring NumPy semantics.  TPU-native rebuild: mx.np
+delegates straight to jax.numpy — which IS a NumPy-semantics operator corpus
+compiled by XLA — wrapping results in the same versioned-slot NDArray
+(presented as mx.np.ndarray).  Autograd records through the same tape: every
+mx.np function dispatches via a registry op, so record()/backward(), hybridize
+tracing and the profiler all see np ops like nd ops.
+
+``npx.set_np()`` (mxnet_tpu.util.set_np) flips Gluon blocks to np arrays —
+here nd and np share one array type, so the switch only changes namespace
+semantics (e.g. zero-dim shapes are always supported).
+"""
+
+from __future__ import annotations
+
+import sys as _sys
+import types as _types
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array as _nd_array
+from ..ops import registry as _reg
+from ..context import current_context
+
+ndarray = NDArray  # mx.np.ndarray is the same array type
+
+_float32 = _onp.float32
+float32 = _onp.float32
+float64 = _onp.float64
+float16 = _onp.float16
+int8 = _onp.int8
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+pi = _onp.pi
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+
+
+def array(object, dtype=None, ctx=None):
+    return _nd_array(object, ctx=ctx, dtype=dtype)
+
+
+def _wrap_jnp(name, jfn):
+    """Expose a jax.numpy function as a recorded registry op."""
+    opname = f"np.{name}"
+    try:
+        op = _reg.get(opname)
+    except MXNetError:
+        def impl(*arrays, **kw):
+            return jfn(*arrays, **kw)
+        impl.__name__ = name
+        op = _reg.Op(opname, impl, num_outputs=-1, jit=False,
+                     doc=getattr(jfn, "__doc__", None))
+        _reg._REGISTRY[opname] = op
+
+    def fn(*args, **kwargs):
+        inputs = []
+        conv_args = []
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+                conv_args.append(None)  # placeholder
+            else:
+                conv_args.append(a)
+        if not inputs:
+            import jax.numpy as jnp
+            out = jfn(*args, **kwargs)
+            if hasattr(out, "dtype"):
+                return NDArray._from_data(jnp.asarray(out),
+                                          ctx=current_context())
+            return out
+        # dispatch through invoke so autograd/tracing see it; non-array
+        # positional args are bound via a closure attr
+        def bound(*arrs, _kw=tuple(sorted(kwargs.items()))):
+            it = iter(arrs)
+            full = [next(it) if c is None else c for c in conv_args]
+            return jfn(*full, **dict(_kw))
+        call_op = _reg.Op(opname, bound, num_outputs=-1, jit=False)
+        res = _reg.invoke(call_op, inputs, {})
+        return res
+    fn.__name__ = name
+    fn.__doc__ = getattr(jfn, "__doc__", None)
+    return fn
+
+
+_NP_FUNCS = [
+    # creation / manipulation
+    "zeros", "ones", "full", "empty", "arange", "linspace", "logspace",
+    "eye", "identity", "meshgrid", "tri", "tril", "triu", "diag", "diagonal",
+    "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
+    "expand_dims", "squeeze", "broadcast_to", "broadcast_arrays",
+    "concatenate", "stack", "vstack", "hstack", "dstack", "column_stack",
+    "split", "array_split", "hsplit", "vsplit", "dsplit", "tile", "repeat",
+    "flip", "fliplr", "flipud", "roll", "rot90", "pad", "append", "insert",
+    "delete", "unique", "sort", "argsort", "where", "extract", "searchsorted",
+    "atleast_1d", "atleast_2d", "atleast_3d", "trim_zeros", "flatnonzero",
+    # math
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "mod", "remainder", "power", "float_power", "sqrt", "cbrt", "square",
+    "absolute", "abs", "fabs", "sign", "exp", "expm1", "exp2", "log", "log2",
+    "log10", "log1p", "sin", "cos", "tan", "arcsin", "arccos", "arctan",
+    "arctan2", "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
+    "hypot", "degrees", "radians", "deg2rad", "rad2deg", "floor", "ceil",
+    "rint", "trunc", "fix", "around", "round", "clip", "maximum", "minimum",
+    "fmax", "fmin", "nan_to_num", "reciprocal", "positive", "negative",
+    "heaviside", "gcd", "lcm", "ldexp", "copysign", "nextafter",
+    "logaddexp", "logaddexp2", "sinc", "interp", "ediff1d", "gradient",
+    "diff", "cross", "trapezoid", "convolve", "correlate",
+    # reductions / scans
+    "sum", "prod", "mean", "std", "var", "median", "average", "percentile",
+    "quantile", "min", "max", "amin", "amax", "ptp", "argmin", "argmax",
+    "nanmin", "nanmax", "nansum", "nanprod", "nanmean", "nanstd", "nanvar",
+    "nanmedian", "nanargmin", "nanargmax", "cumsum", "cumprod", "nancumsum",
+    "nancumprod", "count_nonzero", "bincount", "histogram", "histogram2d",
+    "digitize", "cov", "corrcoef",
+    # logic / comparison
+    "all", "any", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "greater", "greater_equal", "less", "less_equal", "equal", "not_equal",
+    "isclose", "allclose", "array_equal", "isnan", "isinf", "isfinite",
+    "isposinf", "isneginf", "iscomplex", "isreal", "signbit",
+    # linalg-ish in main namespace
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum",
+    "kron", "trace",
+    # indexing
+    "take", "take_along_axis", "put_along_axis", "choose", "compress",
+    "nonzero", "argwhere", "indices", "unravel_index", "ravel_multi_index",
+    "triu_indices", "tril_indices", "diag_indices", "select", "piecewise",
+    # shape info
+    "shape", "ndim", "size", "copyto", "may_share_memory", "result_type",
+    "promote_types", "can_cast", "real", "imag", "conj", "conjugate", "angle",
+    "i0", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "invert",
+    "left_shift", "right_shift",
+]
+
+_self = _sys.modules[__name__]
+
+
+def _populate():
+    import jax.numpy as jnp
+    for name in _NP_FUNCS:
+        if hasattr(_self, name) or not hasattr(jnp, name):
+            continue
+        setattr(_self, name, _wrap_jnp(name, getattr(jnp, name)))
+    # subnamespaces
+    lin = _types.ModuleType(__name__ + ".linalg")
+    import jax.numpy.linalg as jla
+    for name in ("norm", "inv", "det", "slogdet", "solve", "lstsq", "pinv",
+                 "matrix_rank", "matrix_power", "cholesky", "qr", "svd",
+                 "svdvals", "eig", "eigh", "eigvals", "eigvalsh", "cond",
+                 "tensorinv", "tensorsolve", "multi_dot", "cross", "outer",
+                 "matmul", "trace", "vector_norm", "matrix_norm"):
+        if hasattr(jla, name):
+            setattr(lin, name, _wrap_jnp("linalg." + name, getattr(jla, name)))
+    _sys.modules[lin.__name__] = lin
+    _self.linalg = lin
+    # np.random — stateful facade over the context key stream
+    rnd = _types.ModuleType(__name__ + ".random")
+
+    def _rand_wrap(name):
+        import jax
+        def fn(*args, size=None, dtype=None, ctx=None, **kw):
+            from .. import random as _mxr
+            key = _mxr.get_key(ctx or current_context())
+            jr = getattr(jax.random, name)
+            out = _dispatch_random(jr, name, key, args, size, dtype, kw)
+            return NDArray._from_data(out)
+        fn.__name__ = name
+        return fn
+
+    def _dispatch_random(jr, name, key, args, size, dtype, kw):
+        import jax.numpy as jnp
+        shape = size if size is not None else ()
+        if isinstance(shape, int):
+            shape = (shape,)
+        if name == "uniform":
+            low = args[0] if len(args) > 0 else 0.0
+            high = args[1] if len(args) > 1 else 1.0
+            return jr(key, shape, minval=low, maxval=high)
+        if name == "normal":
+            loc = args[0] if len(args) > 0 else 0.0
+            scale = args[1] if len(args) > 1 else 1.0
+            return jr(key, shape) * scale + loc
+        if name == "randint":
+            low = args[0]
+            high = args[1] if len(args) > 1 else None
+            if high is None:
+                low, high = 0, low
+            return jr(key, shape, low, high)
+        return jr(key, *args, shape)
+
+    import jax.random as _jr
+    for name in ("uniform", "normal", "randint"):
+        setattr(rnd, name, _rand_wrap(name))
+
+    def _rand(*dims):
+        return rnd.uniform(0.0, 1.0, size=tuple(dims) if dims else ())
+
+    def _randn(*dims):
+        return rnd.normal(0.0, 1.0, size=tuple(dims) if dims else ())
+
+    def _choice(a, size=None, replace=True, p=None, ctx=None):
+        import jax
+        from .. import random as _mxr
+        key = _mxr.get_key(ctx or current_context())
+        arr = a._data if isinstance(a, NDArray) else a
+        shape = (size,) if isinstance(size, int) else (size or ())
+        out = jax.random.choice(key, arr, shape, replace=replace,
+                                p=p._data if isinstance(p, NDArray) else p)
+        return NDArray._from_data(out)
+
+    def _shuffle(x):
+        import jax
+        from .. import random as _mxr
+        key = _mxr.get_key(current_context())
+        x._set_data(jax.random.permutation(key, x._data, axis=0))
+
+    def _seed(s):
+        from .. import random as _mxr
+        _mxr.seed(s)
+
+    rnd.rand = _rand
+    rnd.randn = _randn
+    rnd.choice = _choice
+    rnd.shuffle = _shuffle
+    rnd.seed = _seed
+    _sys.modules[rnd.__name__] = rnd
+    _self.random = rnd
+
+
+_populate()

@@ -1,0 +1,73 @@
+"""npx — numpy-extension namespace (reference python/mxnet/numpy_extension/):
+set_np/reset_np plus the neural-net ops that have no NumPy equivalent
+(npx.softmax, npx.relu, npx.batch_norm, ...)."""
+
+from __future__ import annotations
+
+import sys as _sys
+
+from ..util import set_np, reset_np, is_np_array, is_np_shape  # noqa: F401
+from ..context import cpu, gpu, tpu, num_gpus, current_context  # noqa: F401
+from ..ops import registry as _reg
+from ..ndarray import register as _ndreg
+
+_self = _sys.modules[__name__]
+
+# npx exposes the nn ops under snake_case names (reference npx.* convention)
+_NPX_OPS = {
+    "softmax": "softmax",
+    "log_softmax": "log_softmax",
+    "relu": "relu",
+    "sigmoid": "sigmoid",
+    "batch_norm": "BatchNorm",
+    "layer_norm": "LayerNorm",
+    "group_norm": "GroupNorm",
+    "instance_norm": "InstanceNorm",
+    "fully_connected": "FullyConnected",
+    "convolution": "Convolution",
+    "deconvolution": "Deconvolution",
+    "pooling": "Pooling",
+    "activation": "Activation",
+    "leaky_relu": "LeakyReLU",
+    "dropout": "Dropout",
+    "embedding": "Embedding",
+    "rnn": "RNN",
+    "one_hot": "one_hot",
+    "pick": "pick",
+    "topk": "topk",
+    "gamma": "gamma",
+    "sequence_mask": "sequence_mask",
+    "reshape_like": "broadcast_like",
+    "batch_dot": "batch_dot",
+    "gather_nd": "gather_nd",
+    "scatter_nd": "scatter_nd",
+    "sign": "sign",
+    "erf": "erf",
+    "erfinv": "erfinv",
+    "smooth_l1": "smooth_l1",
+    "multinomial": "sample_multinomial",
+    "shuffle": "shuffle",
+    "arange_like": "contrib.arange_like",
+}
+
+for _npx_name, _op_name in _NPX_OPS.items():
+    try:
+        setattr(_self, _npx_name,
+                _ndreg._make_op_func(_reg.get(_op_name)))
+    except Exception:
+        pass
+
+
+def waitall():
+    from .. import ndarray as nd
+    nd.waitall()
+
+
+def load(fname):
+    from .. import ndarray as nd
+    return nd.load(fname)
+
+
+def save(fname, data):
+    from .. import ndarray as nd
+    return nd.save(fname, data)

@@ -22,7 +22,9 @@ def _jnp():
 
 
 def _precision():
-    return config.get("MXNET_TPU_DEFAULT_MATMUL_PRECISION", "default")
+    # None defers to the global jax_default_matmul_precision set at import
+    p = config.get("MXNET_TPU_DEFAULT_MATMUL_PRECISION", "highest")
+    return None if p == "default" else p
 
 
 # -- matmul family ----------------------------------------------------------

@@ -548,3 +548,137 @@ def _rnn(data, parameters, state, state_cell=None, state_size=0,
         if mode == "lstm":
             results.append(jnp.stack(c_finals, axis=0))
     return results if len(results) > 1 else results[0]
+
+
+# -- argument-shape inference rules (FInferShape back-propagation role) -----
+# Used by Symbol.infer_shape/simple_bind: given the data shape, derive the
+# parameter shapes the same way the reference's InferShape pass does.
+
+from .registry import get as _get_op
+import numpy as _np_mod
+
+
+def _prod(xs):
+    p = 1
+    for x in xs:
+        p *= x
+    return p
+
+
+def _fc_infer(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    nh = attrs.get("num_hidden", 0)
+    flat = attrs.get("flatten", True)
+    in_units = _prod(data[1:]) if flat else data[-1]
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = (nh, in_units)
+    if len(out) > 2 and out[2] is None and not attrs.get("no_bias", False):
+        out[2] = (nh,)
+    return out
+
+
+_get_op("FullyConnected").infer_args = _fc_infer
+
+
+def _conv_infer(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    k = tuple(attrs.get("kernel", ()))
+    nf = attrs.get("num_filter", 0)
+    g = attrs.get("num_group", 1)
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = (nf, data[1] // g) + k
+    if len(out) > 2 and out[2] is None and not attrs.get("no_bias", False):
+        out[2] = (nf,)
+    return out
+
+
+_get_op("Convolution").infer_args = _conv_infer
+
+
+def _deconv_infer(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    k = tuple(attrs.get("kernel", ()))
+    nf = attrs.get("num_filter", 0)
+    g = attrs.get("num_group", 1)
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = (data[1], nf // g) + k
+    if len(out) > 2 and out[2] is None and not attrs.get("no_bias", True):
+        out[2] = (nf,)
+    return out
+
+
+_get_op("Deconvolution").infer_args = _deconv_infer
+
+
+def _bn_infer(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    c = data[attrs.get("axis", 1)]
+    return [shapes[0]] + [(c,) if s is None else s for s in shapes[1:]]
+
+
+_get_op("BatchNorm").infer_args = _bn_infer
+
+
+def _chan_infer(shapes, attrs):  # noqa: ARG001 - LayerNorm/InstanceNorm/GroupNorm
+    data = shapes[0]
+    if data is None:
+        return shapes
+    axis = attrs.get("axis", -1)
+    c = data[axis]
+    return [shapes[0]] + [(c,) if s is None else s for s in shapes[1:]]
+
+
+_get_op("LayerNorm").infer_args = _chan_infer
+_get_op("GroupNorm").infer_args = \
+    lambda shapes, attrs: [shapes[0]] + [
+        (shapes[0][1],) if s is None else s for s in shapes[1:]] \
+    if shapes[0] is not None else shapes
+_get_op("InstanceNorm").infer_args = _get_op("GroupNorm").infer_args
+
+
+def _embedding_infer(shapes, attrs):
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = (attrs.get("input_dim", 0), attrs.get("output_dim", 0))
+    return out
+
+
+_get_op("Embedding").infer_args = _embedding_infer
+
+
+def _rnn_infer(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    mode = attrs.get("mode", "lstm")
+    H = attrs.get("state_size", 0)
+    L = attrs.get("num_layers", 1)
+    d = 2 if attrs.get("bidirectional", False) else 1
+    ng = _gates(mode)
+    I = data[2]
+    size = 0
+    for l in range(L):
+        in_sz = I if l == 0 else H * d
+        size += d * (ng * H * in_sz + ng * H * H + 2 * ng * H)
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = (size,)
+    N = data[1]
+    for i in (2, 3):
+        if len(out) > i and out[i] is None:
+            out[i] = (L * d, N, H)
+    return out
+
+
+_get_op("RNN").infer_args = _rnn_infer

@@ -62,7 +62,7 @@ class Op:
 
     __slots__ = ("name", "fn", "num_outputs", "differentiable",
                  "mutate_inputs", "wrap_key", "wrap_train", "doc", "jit",
-                 "visible_outputs", "dynamic_attrs")
+                 "visible_outputs", "dynamic_attrs", "infer_args")
 
     def __init__(self, name, fn, num_outputs=1, differentiable=True,
                  mutate_inputs=(), wrap_key=None, wrap_train=None, jit=True,
@@ -83,6 +83,10 @@ class Op:
         # per-step-varying value (lr schedule, lamb's t) does not trigger a
         # fresh XLA compile per value.
         self.dynamic_attrs = tuple(dynamic_attrs)
+        # infer_args(known_shapes, attrs) -> shapes — fills unknown input
+        # shapes from known ones (the FInferShape backward-propagation role,
+        # used by Symbol.infer_shape / simple_bind)
+        self.infer_args = None
 
     def __repr__(self):
         return f"<Op {self.name}>"
@@ -202,6 +206,13 @@ def invoke(op, inputs, attrs=None, out=None, ctx=None):
     if op.wrap_train is not None and op.wrap_train not in attrs:
         attrs[op.wrap_train] = autograd.is_training()
 
+    import sys as _sys
+    _prof = _sys.modules.get("mxnet_tpu.profiler")
+    _t0 = None
+    if _prof is not None and _prof.is_running():
+        import time as _time
+        _t0 = _time.perf_counter()
+
     recording = autograd.is_recording() and op.differentiable
     if recording:
         # capture residuals now; backward replays the stored closure only
@@ -214,6 +225,11 @@ def invoke(op, inputs, attrs=None, out=None, ctx=None):
 
     out_arrays = _normalize_out(op, out_raw)
     engine.on_dispatch(out_arrays)
+
+    if _t0 is not None:
+        import time as _time
+        # host dispatch time; device time lives in the XLA trace (N20 split)
+        _prof.record_op(op.name, _time.perf_counter() - _t0)
 
     # mutate_inputs ops (running stats etc.): write back into input slots
     for out_idx, in_idx in op.mutate_inputs:

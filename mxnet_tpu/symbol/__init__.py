@@ -1,0 +1,12 @@
+"""mx.sym — symbolic graph API (reference python/mxnet/symbol/, P4)."""
+
+import sys as _sys
+
+from .symbol import (  # noqa: F401
+    Symbol, var, Variable, Group, load, load_json, zeros, ones,
+)
+from . import register as _register
+
+_GENERATED = _register.populate(_sys.modules[__name__])
+
+from . import contrib  # noqa: F401,E402

@@ -1,0 +1,106 @@
+"""Control-flow sugar (reference python/mxnet/symbol/contrib.py ::
+foreach/while_loop/cond and src/operator/control_flow.cc).
+
+TPU-native: these are thin wrappers over lax.scan/while_loop/cond working on
+BOTH NDArrays (imperative, traceable under hybridize) and raw jax arrays —
+the reference's subgraph-op machinery (_foreach/_while_loop/_cond stateful
+ops with autograd through loops) is exactly what lax gives natively,
+including differentiation through scan.
+"""
+
+from __future__ import annotations
+
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _wrap(x):
+    import jax
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(v) for v in x)
+    if isinstance(x, (jax.Array,)) or hasattr(x, "dtype"):
+        return NDArray._from_data(x)
+    return x
+
+
+def foreach(body, data, init_states):
+    """reference contrib.foreach: scan body(data_slice, states) ->
+    (out, new_states) over axis 0 of data."""
+    import jax
+
+    def jbody(states, x):
+        out, new_states = body(_wrap(x), _wrap(states))
+        return _unwrap(new_states), _unwrap(out)
+
+    states0 = _unwrap(init_states)
+    xs = _unwrap(data)
+    final_states, outs = jax.lax.scan(jbody, states0, xs)
+    return _wrap(outs), _wrap(final_states)
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None):
+    """reference contrib.while_loop.  Static shapes require max_iterations;
+    lax.while_loop is used when no per-step outputs are collected."""
+    import jax
+    import jax.numpy as jnp
+
+    if max_iterations is None:
+        # pure state evolution, no stacked outputs
+        def jcond(vs):
+            r = cond_fn(*_wrap(list(vs)))
+            return r._data.astype(bool).reshape(()) \
+                if isinstance(r, NDArray) else jnp.asarray(r, bool).reshape(())
+
+        def jbody(vs):
+            _, new_vars = func(*_wrap(list(vs)))
+            return tuple(_unwrap(new_vars))
+
+        out_vars = jax.lax.while_loop(jcond, jbody,
+                                      tuple(_unwrap(loop_vars)))
+        return [], _wrap(list(out_vars))
+
+    # bounded loop with collected outputs: scan with an active mask
+    def jbody(carry, _):
+        vs, active, count = carry
+        pred = cond_fn(*_wrap(list(vs)))
+        pred = pred._data.astype(bool).reshape(()) \
+            if isinstance(pred, NDArray) else jnp.asarray(pred, bool)
+        step_out, new_vars = func(*_wrap(list(vs)))
+        step_out = _unwrap(step_out if isinstance(step_out, (list, tuple))
+                           else [step_out])
+        new_vars = tuple(_unwrap(new_vars))
+        take = jnp.logical_and(active, pred)
+        vs_next = tuple(jnp.where(take, nv, ov)
+                        for nv, ov in zip(new_vars, vs))
+        count = count + take.astype(jnp.int32)
+        return (vs_next, take, count), tuple(step_out)
+
+    vs0 = tuple(_unwrap(loop_vars))
+    (vs_f, _, n), outs = jax.lax.scan(
+        jbody, (vs0, jnp.asarray(True), jnp.asarray(0, jnp.int32)),
+        None, length=max_iterations)
+    return _wrap(list(outs)), _wrap(list(vs_f))
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """reference contrib.cond → lax.cond."""
+    import jax
+    import jax.numpy as jnp
+    p = pred() if callable(pred) else pred
+    if isinstance(p, NDArray):
+        p = p._data
+    p = jnp.asarray(p).astype(bool).reshape(())
+    out = jax.lax.cond(p,
+                       lambda _: _unwrap(then_func()),
+                       lambda _: _unwrap(else_func()),
+                       operand=None)
+    return _wrap(out)

@@ -1,0 +1,136 @@
+"""Executor — the bound symbolic graph (reference src/executor/
+graph_executor.cc N6 + python/mxnet/executor.py).
+
+Bind lowers the Symbol DAG into one jitted forward (and a vjp-backed
+backward); memory planning/in-place/bulking are XLA's.  API parity:
+forward(is_train, **kwargs), backward(out_grads), outputs, arg_dict,
+grad_dict, aux_dict, copy_params_from, reshape.
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states):
+        self._symbol = symbol
+        self._ctx = ctx
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        self.arg_dict = dict(args or {})
+        self.grad_dict = dict(args_grad or {})
+        self.aux_dict = dict(aux_states or {})
+        self.grad_req = grad_req
+        missing = [a for a in arg_names if a not in self.arg_dict]
+        if missing:
+            raise MXNetError(f"bind missing arguments: {missing}")
+        self._run, self._leaves = symbol._build_fn()
+        self.outputs = []
+        self._vjp = None
+        self._jit = None
+
+    def _leaf_arrays(self, extra=None):
+        arrays = []
+        for s in self._leaves:
+            name = s._name
+            src = None
+            if extra and name in extra:
+                src = extra[name]
+            elif name in self.arg_dict:
+                src = self.arg_dict[name]
+            elif name in self.aux_dict:
+                src = self.aux_dict[name]
+            else:
+                raise MXNetError(f"no value bound for input {name!r}")
+            arrays.append(src._data if isinstance(src, NDArray) else src)
+        return arrays
+
+    def forward(self, is_train=False, **kwargs):
+        import jax
+        from .. import autograd
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(
+                    v._data if isinstance(v, NDArray) else v)
+        arrays = self._leaf_arrays()
+        if self._jit is None:
+            self._jit = jax.jit(self._run)
+        with autograd._scope(training=is_train):
+            if is_train and self.grad_req != "null":
+                out, self._vjp = jax.vjp(self._jit, *arrays)
+            else:
+                out = self._jit(*arrays)
+                self._vjp = None
+        self._out_was_tuple = isinstance(out, tuple)
+        outs = out if self._out_was_tuple else (out,)
+        self.outputs = [NDArray._from_data(o, ctx=self._ctx) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):  # noqa: ARG002
+        import jax.numpy as jnp
+        if self._vjp is None:
+            raise MXNetError("backward requires forward(is_train=True) first")
+        if out_grads is None:
+            cts = tuple(jnp.ones(o.shape, o.dtype) for o in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = tuple(g._data for g in out_grads)
+        ct_arg = cts if self._out_was_tuple else cts[0]
+        grads = self._vjp(ct_arg)
+        for s, g in zip(self._leaves, grads):
+            dst = self.grad_dict.get(s._name)
+            if dst is None:
+                continue
+            if self.grad_req == "add":
+                dst._set_data(dst._data + g)
+            elif self.grad_req != "null":
+                dst._set_data(g)
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[a] for a in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(a)
+                for a in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[a]
+                for a in self._symbol.list_auxiliary_states()]
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(v._data)
+            elif not allow_extra_params:
+                raise MXNetError(f"extra param {k}")
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    self.aux_dict[k]._set_data(v._data)
+                elif not allow_extra_params:
+                    raise MXNetError(f"extra aux {k}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):  # noqa: ARG002
+        args = {k: nd.zeros(v, ctx=self._ctx) for k, v in kwargs.items()
+                if k in self.arg_dict}
+        new_args = dict(self.arg_dict)
+        new_args.update(args)
+        grads = {k: nd.zeros(v.shape, ctx=self._ctx)
+                 for k, v in new_args.items()} \
+            if self.grad_req != "null" else None
+        return Executor(self._symbol, self._ctx, new_args, grads,
+                        self.grad_req, dict(self.aux_dict))

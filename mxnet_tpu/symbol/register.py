@@ -1,0 +1,63 @@
+"""Generate mx.sym.<op> namespaces from the registry (reference
+python/mxnet/symbol/register.py) — mirrors ndarray.register but produces
+Symbols."""
+
+from __future__ import annotations
+
+import sys
+import types
+
+from ..ops import registry as _reg
+from .symbol import Symbol, _make
+
+_counter = {}
+
+
+def _auto_name(opname):
+    base = opname.split(".")[-1].lower()
+    n = _counter.get(base, 0)
+    _counter[base] = n + 1
+    return f"{base}{n}"
+
+
+def _make_sym_func(op):
+    def fn(*args, name=None, attr=None, **attrs):
+        inputs = [a for a in args if isinstance(a, Symbol)]
+        s = Symbol(op, inputs, attrs, name=name or _auto_name(op.name),
+                   num_outputs=op.num_outputs if op.num_outputs > 0 else 1)
+        if attr:
+            s._attrs.update(attr)
+        return s
+    fn.__name__ = op.name.split(".")[-1]
+    fn.__doc__ = op.doc or f"symbolic wrapper for operator {op.name!r}"
+    return fn
+
+
+def populate(target_module, prefix=""):
+    installed = []
+    for name in _reg.list_ops():
+        local = name
+        fn = _make_sym_func(_reg.get(name))
+        if "." in local:
+            ns, leaf = local.split(".", 1)
+            if "." in leaf:
+                continue
+            modname = f"{target_module.__name__}.{ns}"
+            mod = sys.modules.get(modname)
+            if mod is None:
+                mod = types.ModuleType(modname)
+                sys.modules[modname] = mod
+            if not hasattr(target_module, ns):
+                setattr(target_module, ns, mod)
+            sub = getattr(target_module, ns)
+            if not hasattr(sub, leaf):
+                setattr(sub, leaf, fn)
+                installed.append(f"{ns}.{leaf}")
+            flat = local.replace(".", "_")
+            if not hasattr(target_module, flat):
+                setattr(target_module, flat, fn)
+        else:
+            if not hasattr(target_module, local):
+                setattr(target_module, local, fn)
+                installed.append(local)
+    return installed

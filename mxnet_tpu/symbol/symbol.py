@@ -1,0 +1,395 @@
+"""Symbol: the lazy computation-graph API.
+
+Rebuild of python/mxnet/symbol/symbol.py + nnvm's Symbol/Graph (N25, P4).
+A Symbol is a DAG node over the SAME operator registry the imperative path
+uses; ``bind`` lowers the whole graph into one ``jax.jit``-compiled function
+(the GraphExecutor N6 role — shape inference, memory planning, device
+placement and bulking are all XLA's job now, SURVEY §7.1).
+
+Supported reference surface: var/Group, composition, list_arguments/
+list_outputs/list_auxiliary_states, infer_shape/infer_type (via abstract
+evaluation), bind/simple_bind → Executor(forward/backward/outputs),
+eval, tojson/load_json/save/load, attributes (incl. ``__ctx_group__`` — the
+manual model-parallel hint, mapped to sharding annotations by the parallel
+trainer), and the generated mx.sym.<op> namespaces.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from ..ops import registry as _reg
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "zeros", "ones"]
+
+
+class Symbol:
+    def __init__(self, op=None, inputs=(), attrs=None, name=None,
+                 num_outputs=1, out_index=None):
+        self._op = op                  # None for var; "group" for Group
+        self._inputs = list(inputs)
+        self._attrs = dict(attrs or {})
+        self._name = name or (op.name if op else "var")
+        self._num_outputs = num_outputs
+        self._out_index = out_index    # int when slicing one output
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def name(self):
+        return self._name
+
+    def attr(self, key):
+        return self._attrs.get(key)
+
+    def _set_attr(self, **kwargs):
+        self._attrs.update(kwargs)
+
+    def list_attr(self):
+        return {k: str(v) for k, v in self._attrs.items()}
+
+    def _walk(self, seen=None, order=None):
+        if seen is None:
+            seen, order = set(), []
+        if id(self) in seen:
+            return order
+        seen.add(id(self))
+        for i in self._inputs:
+            i._walk(seen, order)
+        order.append(self)
+        return order
+
+    def list_arguments(self):
+        return [s._name for s in self._walk()
+                if s._op is None and not s._attrs.get("__aux__")]
+
+    def list_auxiliary_states(self):
+        return [s._name for s in self._walk()
+                if s._op is None and s._attrs.get("__aux__")]
+
+    def list_inputs(self):
+        return [s._name for s in self._walk() if s._op is None]
+
+    def list_outputs(self):
+        if self._op == "group":
+            return [o for i in self._inputs for o in i.list_outputs()]
+        return [f"{self._name}_output"]
+
+    @property
+    def num_outputs(self):
+        if self._op == "group":
+            return sum(i.num_outputs for i in self._inputs)
+        return 1 if self._out_index is not None else self._num_outputs
+
+    def __getitem__(self, index):
+        if self._op == "group":
+            return self._inputs[index]
+        if isinstance(index, int):
+            if self._num_outputs == 1 and index == 0:
+                return self
+            return Symbol("output_slice", [self], {"index": index},
+                          name=f"{self._name}[{index}]")
+        raise MXNetError("symbol indexing requires an int")
+
+    def get_internals(self):
+        return Group(*[s for s in self._walk() if s._op is not None])
+
+    def get_children(self):
+        return Group(*self._inputs) if self._inputs else None
+
+    # -- composition sugar (same dunder surface as NDArray) ------------------
+    def _binop(self, opname, other, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            ins = [other, self] if reverse else [self, other]
+            return _make(opname, ins, {})
+        return _make(scalar_op, [self],
+                     {"scalar": float(other), "reverse": reverse})
+
+    def __add__(self, o):
+        return self._binop("broadcast_add", o, "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop("broadcast_sub", o, "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binop("broadcast_sub", o, "_minus_scalar", True)
+
+    def __mul__(self, o):
+        return self._binop("broadcast_mul", o, "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop("broadcast_div", o, "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._binop("broadcast_div", o, "_div_scalar", True)
+
+    def __pow__(self, o):
+        return self._binop("broadcast_power", o, "_power_scalar")
+
+    def __neg__(self):
+        return _make("negative", [self], {})
+
+    def reshape(self, shape):
+        return _make("reshape", [self], {"shape": tuple(shape)})
+
+    def transpose(self, axes=None):
+        return _make("transpose", [self], {"axes": axes})
+
+    # -- evaluation ----------------------------------------------------------
+    def _leaf_syms(self):
+        return [s for s in self._walk() if s._op is None]
+
+    def _build_fn(self):
+        """Lower the DAG to a python function over leaf arrays (traceable)."""
+        leaves = self._leaf_syms()
+        leaf_pos = {id(s): i for i, s in enumerate(leaves)}
+
+        def run(*arrays):
+            cache = {}
+
+            def ev(s):
+                if id(s) in cache:
+                    return cache[id(s)]
+                if s._op is None:
+                    v = arrays[leaf_pos[id(s)]]
+                elif s._op == "group":
+                    v = tuple(x for i in s._inputs
+                              for x in _as_tuple(ev(i)))
+                elif s._op == "output_slice":
+                    v = _as_tuple(ev(s._inputs[0]))[s._attrs["index"]]
+                else:
+                    ins = []
+                    for i in s._inputs:
+                        x = ev(i)
+                        # a multi-output producer feeds its first output
+                        # unless explicitly sliced (reference nnvm entries)
+                        ins.append(x[0] if isinstance(x, (tuple, list)) else x)
+                    v = _reg.invoke_arrays(s._op, ins, s._attrs)
+                    if isinstance(v, list):
+                        v = tuple(v)
+                cache[id(s)] = v
+                return v
+            return ev(self)
+        return run, leaves
+
+    def eval(self, ctx=None, **kwargs):
+        run, leaves = self._build_fn()
+        arrays = []
+        for s in leaves:
+            if s._name not in kwargs:
+                raise MXNetError(f"eval missing argument {s._name!r}")
+            v = kwargs[s._name]
+            arrays.append(v._data if isinstance(v, NDArray) else v)
+        out = run(*arrays)
+        outs = _as_tuple(out)
+        return [NDArray._from_data(o, ctx=ctx) for o in outs]
+
+    def infer_shape(self, **kwargs):
+        """arg_shapes, out_shapes, aux_shapes.
+
+        Forward abstract evaluation node-by-node, with per-op ``infer_args``
+        rules filling parameter shapes from data shapes — the bidirectional
+        role of the reference's InferShape pass (simple_bind only needs the
+        data/label shapes, like the reference)."""
+        import jax
+        shape_of = {}   # id(sym) -> shape tuple | tuple-of-tuples
+        dtype_of = {}
+        order = self._walk()
+        for s in order:
+            if s._op is None:
+                shp = kwargs.get(s._name, s._attrs.get("__shape__"))
+                shape_of[id(s)] = tuple(shp) if shp is not None else None
+                dtype_of[id(s)] = s._attrs.get("__dtype__", _np.float32)
+        for s in order:
+            if s._op is None:
+                continue
+            if s._op == "group":
+                outs = []
+                for i in s._inputs:
+                    v = shape_of.get(id(i))
+                    outs.extend(v if isinstance(v, list) else [v])
+                shape_of[id(s)] = outs
+                continue
+            if s._op == "output_slice":
+                v = shape_of.get(id(s._inputs[0]))
+                shape_of[id(s)] = v[s._attrs["index"]] \
+                    if isinstance(v, list) else v
+                continue
+            in_shapes = []
+            for i in s._inputs:
+                v = shape_of.get(id(i))
+                in_shapes.append(v[0] if isinstance(v, list) else v)
+            if s._op.infer_args is not None and any(
+                    sh is None for sh in in_shapes):
+                filled = s._op.infer_args(in_shapes, s._attrs)
+                for i, sh in zip(s._inputs, filled):
+                    if sh is not None and shape_of.get(id(i)) is None \
+                            and i._op is None:
+                        shape_of[id(i)] = tuple(sh)
+                in_shapes = filled
+            if any(sh is None for sh in in_shapes):
+                return None, None, None
+            structs = [jax.ShapeDtypeStruct(tuple(sh),
+                                            dtype_of.get(id(i), _np.float32))
+                       for i, sh in zip(s._inputs, in_shapes)]
+            try:
+                out = jax.eval_shape(
+                    lambda *a, _s=s: _reg.invoke_arrays(_s._op, list(a),
+                                                        _s._attrs), *structs)
+            except Exception as e:
+                raise MXNetError(
+                    f"infer_shape failed at node {s._name!r}: {e}") from e
+            if isinstance(out, (tuple, list)):
+                shape_of[id(s)] = [tuple(o.shape) for o in out]
+            else:
+                shape_of[id(s)] = tuple(out.shape)
+        args = self.list_arguments()
+        auxs = self.list_auxiliary_states()
+        name2shape = {s._name: shape_of.get(id(s))
+                      for s in order if s._op is None}
+        head = shape_of.get(id(self))
+        out_shapes = head if isinstance(head, list) else [head]
+        return ([name2shape[a] for a in args], out_shapes,
+                [name2shape[a] for a in auxs])
+
+    def infer_type(self, **kwargs):
+        args = self.list_arguments()
+        dt = kwargs.get(args[0], _np.float32) if args else _np.float32
+        return ([dt] * len(args), [dt], [])
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):  # noqa: ARG002
+        from .executor import Executor
+        return Executor(self, ctx or current_context(), args, args_grad,
+                        grad_req, aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
+        if arg_shapes is None:
+            raise MXNetError("simple_bind could not infer all shapes; pass "
+                             "every input shape")
+        args = {a: nd.zeros(s, ctx=ctx)
+                for a, s in zip(self.list_arguments(), arg_shapes)}
+        args_grad = {a: nd.zeros(s, ctx=ctx)
+                     for a, s in zip(self.list_arguments(), arg_shapes)} \
+            if grad_req != "null" else None
+        aux = {a: nd.zeros(s, ctx=ctx)
+               for a, s in zip(self.list_auxiliary_states(), aux_shapes)}
+        return self.bind(ctx, args, args_grad, grad_req, aux)
+
+    def optimize_for(self, backend, **kwargs):  # noqa: ARG002
+        """Graph-rewrite entry (reference MXOptimizeForBackend/N9).  XLA is
+        the single backend; returns self."""
+        return self
+
+    # -- serialization -------------------------------------------------------
+    def tojson(self):
+        """Serialize the DAG.  Schema is documented ('mxnet_tpu.sym.v1'): the
+        reference's nnvm JSON needs op names/attrs we preserve 1:1, so graphs
+        round-trip within this framework; cross-loading reference JSON is a
+        best-effort name-match."""
+        order = self._walk()
+        idx = {id(s): i for i, s in enumerate(order)}
+        nodes = []
+        for s in order:
+            nodes.append({
+                "op": "null" if s._op is None else (
+                    s._op if isinstance(s._op, str) else s._op.name),
+                "name": s._name,
+                "attrs": {k: repr(v) for k, v in s._attrs.items()},
+                "inputs": [[idx[id(i)], 0, 0] for i in s._inputs],
+            })
+        return json.dumps({"format": "mxnet_tpu.sym.v1", "nodes": nodes,
+                           "heads": [[len(order) - 1, 0, 0]]}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def __repr__(self):
+        return f"<Symbol {self._name}>"
+
+
+def _as_tuple(v):
+    if isinstance(v, tuple):
+        return v
+    if isinstance(v, list):
+        return tuple(v)
+    return (v,)
+
+
+def _make(opname, inputs, attrs, name=None):
+    op = _reg.get(opname)
+    return Symbol(op, inputs, attrs,
+                  name=name or f"{opname.replace('.', '_')}{id(attrs) % 997}")
+
+
+def var(name, attr=None, shape=None, dtype=None, init=None, stype=None,
+        **kwargs):  # noqa: ARG001
+    s = Symbol(None, name=name)
+    if shape is not None:
+        s._attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        s._attrs["__dtype__"] = dtype
+    if attr:
+        s._attrs.update(attr)
+    s._attrs.update(kwargs)
+    return s
+
+
+Variable = var
+
+
+def Group(*symbols):
+    if len(symbols) == 1 and isinstance(symbols[0], (list, tuple)):
+        symbols = tuple(symbols[0])
+    return Symbol("group", list(symbols), name="group")
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = data["nodes"]
+    built = []
+    import ast
+    for n in nodes:
+        attrs = {}
+        for k, v in n.get("attrs", {}).items():
+            try:
+                attrs[k] = ast.literal_eval(v)
+            except (ValueError, SyntaxError):
+                attrs[k] = v
+        ins = [built[i[0]] for i in n.get("inputs", [])]
+        if n["op"] == "null":
+            s = Symbol(None, name=n["name"], attrs=attrs)
+        elif n["op"] in ("group", "output_slice"):
+            s = Symbol(n["op"], ins, attrs, name=n["name"])
+        else:
+            s = Symbol(_reg.get(n["op"]), ins, attrs, name=n["name"])
+        built.append(s)
+    head = data.get("heads", [[len(built) - 1, 0, 0]])[0][0]
+    return built[head]
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def zeros(shape, dtype=None, **kwargs):
+    return _make("_zeros", [], {"shape": tuple(shape),
+                                "dtype": dtype or "float32"}, **kwargs)
+
+
+def ones(shape, dtype=None, **kwargs):
+    return _make("_ones", [], {"shape": tuple(shape),
+                               "dtype": dtype or "float32"}, **kwargs)

@@ -1,0 +1,182 @@
+"""Test utilities (reference python/mxnet/test_utils.py, SURVEY §4.2).
+
+The numeric oracles the reference test-suite is built on:
+``assert_almost_equal`` (dtype-aware tolerances), ``check_numeric_gradient``
+(finite differences vs autograd), ``check_consistency`` (same graph across
+contexts — THE cpu↔tpu kernel oracle), ``default_context`` (the ctx-injection
+point the whole suite parameterizes over), random array generators.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, current_context, cpu
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
+           "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient",
+           "check_consistency", "default_rtols", "effective_dtype"]
+
+_default_ctx = None
+
+
+def default_context():
+    return _default_ctx if _default_ctx is not None else current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+    Context._default_ctx.value = ctx
+
+
+_RTOLS = {
+    _np.dtype(_np.float16): 1e-2,
+    _np.dtype(_np.float32): 1e-4,
+    _np.dtype(_np.float64): 1e-6,
+}
+_ATOLS = {
+    _np.dtype(_np.float16): 1e-3,
+    _np.dtype(_np.float32): 1e-5,
+    _np.dtype(_np.float64): 1e-8,
+}
+try:
+    from .base import bfloat16 as _bf16
+    if _bf16 is not None:
+        _RTOLS[_np.dtype(_bf16)] = 2e-2
+        _ATOLS[_np.dtype(_bf16)] = 2e-2
+except ImportError:
+    pass
+
+
+def effective_dtype(arr):
+    return _np.dtype(arr.dtype)
+
+
+def default_rtols(a=None, b=None):
+    cands = [x for x in (a, b) if x is not None]
+    rtol = max((_RTOLS.get(effective_dtype(x), 1e-4) for x in cands),
+               default=1e-4)
+    atol = max((_ATOLS.get(effective_dtype(x), 1e-5) for x in cands),
+               default=1e-5)
+    return rtol, atol
+
+
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def same(a, b):
+    return _np.array_equal(_to_np(a), _to_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    a, b = _to_np(a), _to_np(b)
+    if rtol is None or atol is None:
+        drtol, datol = default_rtols(a, b)
+        rtol = rtol if rtol is not None else drtol
+        atol = atol if atol is not None else datol
+    return _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    an, bn = _to_np(a), _to_np(b)
+    if rtol is None or atol is None:
+        drtol, datol = default_rtols(an, bn)
+        rtol = rtol if rtol is not None else drtol
+        atol = atol if atol is not None else datol
+    if not _np.allclose(an, bn, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        diff = _np.abs(an.astype(_np.float64) - bn.astype(_np.float64))
+        rel = diff / (_np.abs(bn).astype(_np.float64) + atol)
+        raise AssertionError(
+            f"{names[0]} and {names[1]} differ: max abs {diff.max():.3g}, "
+            f"max rel {rel.max():.3g} (rtol={rtol}, atol={atol})\n"
+            f"{names[0]}: {an.ravel()[:8]}...\n{names[1]}: {bn.ravel()[:8]}...")
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1),
+            _np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_np.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=_np.float32,
+                 ctx=None):
+    if stype == "default":
+        return nd.array(_np.random.uniform(-1, 1, shape).astype(dtype),
+                        ctx=ctx)
+    from .ndarray import sparse as sp
+    density = density if density is not None else 0.5
+    arr = _np.random.uniform(-1, 1, shape).astype(dtype)
+    mask = _np.random.uniform(0, 1, shape[0]) < density
+    arr[~mask] = 0
+    if stype == "row_sparse":
+        return sp.row_sparse_array(arr, ctx=ctx)
+    if stype == "csr":
+        flat_mask = _np.random.uniform(0, 1, shape) < density
+        arr = arr * flat_mask
+        return sp.csr_matrix(arr, ctx=ctx)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def check_numeric_gradient(f, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
+    """Finite differences vs autograd on scalar-valued f(inputs)->NDArray."""
+    from . import autograd
+    ins = [x if isinstance(x, NDArray) else nd.array(x) for x in inputs]
+    for x in ins:
+        x.attach_grad()
+    with autograd.record():
+        y = f(*ins)
+        if y.size != 1:
+            y = y.sum()
+    y.backward()
+    for i, x in enumerate(ins):
+        xn = x.asnumpy().astype(_np.float64)
+        num = _np.zeros_like(xn)
+        for idx in _np.ndindex(*xn.shape):
+            xp = xn.copy()
+            xp[idx] += eps
+            xm = xn.copy()
+            xm[idx] -= eps
+            args_p = [nd.array(xp.astype(x.dtype)) if j == i else ins[j]
+                      for j in range(len(ins))]
+            args_m = [nd.array(xm.astype(x.dtype)) if j == i else ins[j]
+                      for j in range(len(ins))]
+            fp = float(f(*args_p).sum().asnumpy())
+            fm = float(f(*args_m).sum().asnumpy())
+            num[idx] = (fp - fm) / (2 * eps)
+        assert_almost_equal(x.grad.asnumpy(), num, rtol=rtol, atol=atol,
+                            names=(f"autograd[{i}]", f"numeric[{i}]"))
+
+
+def check_consistency(f, inputs_np, ctx_list=None, rtol=None, atol=None):
+    """Run the same computation on every context and cross-check — the
+    reference's cpu↔gpu oracle, now cpu↔tpu (SURVEY §4.2)."""
+    if ctx_list is None:
+        ctx_list = [cpu()]
+        from .context import num_tpus, tpu
+        if num_tpus() > 0:
+            ctx_list.append(tpu())
+    outs = []
+    for ctx in ctx_list:
+        ins = [nd.array(x, ctx=ctx) for x in inputs_np]
+        out = f(*ins)
+        outs.append(_to_np(out))
+    for i in range(1, len(outs)):
+        assert_almost_equal(outs[0], outs[i], rtol=rtol, atol=atol,
+                            names=(str(ctx_list[0]), str(ctx_list[i])))
+    return outs

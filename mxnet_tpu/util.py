@@ -1,0 +1,88 @@
+"""mx.util (reference python/mxnet/util.py): numpy-semantics switch
+(set_np/is_np_array), misc decorators."""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+__all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "set_np_shape",
+           "use_np", "np_array", "np_shape", "getenv", "setenv"]
+
+_tls = threading.local()
+
+
+def _st():
+    if not hasattr(_tls, "np_array"):
+        _tls.np_array = False
+        _tls.np_shape = False
+    return _tls
+
+
+def is_np_array():
+    return _st().np_array
+
+
+def is_np_shape():
+    return _st().np_shape
+
+
+def set_np(shape=True, array=True, dtype=False):  # noqa: ARG001
+    """npx.set_np — flip Gluon/NDArray into NumPy semantics (P3)."""
+    s = _st()
+    s.np_array = array
+    s.np_shape = shape
+
+
+def reset_np():
+    set_np(shape=False, array=False)
+
+
+def set_np_shape(active):
+    prev = _st().np_shape
+    _st().np_shape = active
+    return prev
+
+
+class _NpScope:
+    def __init__(self, shape=True, array=True):
+        self._shape = shape
+        self._array = array
+
+    def __enter__(self):
+        s = _st()
+        self._old = (s.np_shape, s.np_array)
+        s.np_shape, s.np_array = self._shape, self._array
+        return self
+
+    def __exit__(self, *exc):
+        s = _st()
+        s.np_shape, s.np_array = self._old
+        return False
+
+
+def np_array(active=True):
+    return _NpScope(shape=_st().np_shape, array=active)
+
+
+def np_shape(active=True):
+    return _NpScope(shape=active, array=_st().np_array)
+
+
+def use_np(func):
+    """Decorator: run func under np semantics (reference util.use_np)."""
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with _NpScope(True, True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def getenv(name):
+    import os
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    import os
+    os.environ[name] = value

@@ -1,0 +1,39 @@
+"""Test harness config.
+
+Mirrors the reference strategy (SURVEY §4): the suite runs on a *virtual
+8-device CPU platform* so multi-device/sharding paths are exercised without
+TPU hardware — XLA_FLAGS must be set before jax imports.  Seeding follows
+tests/python/unittest/common.py: MXNET_TEST_SEED / MXNET_MODULE_SEED control
+reproduction; each test gets a seed logged on failure via the with_seed
+fixture below.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def seeded(request):
+    """Per-test deterministic seeding with printed repro seed on failure
+    (reference common.py :: with_seed)."""
+    import mxnet_tpu as mx
+    seed = int(os.environ.get("MXNET_TEST_SEED",
+                              abs(hash(request.node.name)) % (2 ** 31)))
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    yield
+    # seed printed by pytest on failure via -ra and the node repr
+
+
+@pytest.fixture
+def ctx():
+    from mxnet_tpu.test_utils import default_context
+    return default_context()

@@ -1,0 +1,169 @@
+"""Autograd tests (reference tests/python/unittest/test_autograd.py +
+test_higher_order_grad.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_basic_backward():
+    x = nd.array([1., 2., 3.])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain_and_fanout():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 3
+        b = x * 5
+        y = a * b  # y = 15 x^2 → dy/dx = 30x
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [60.0])
+
+
+def test_head_gradient():
+    x = nd.array([1., 2.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([10., 100.]))
+    assert_almost_equal(x.grad.asnumpy(), [20, 200])
+
+
+def test_grad_req_add_and_write():
+    x = nd.array([1., 1.])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [6, 6])
+    x.attach_grad(grad_req="write")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [2, 2])
+
+
+def test_detach_stops_grad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y.detach() * 5
+    z.backward()
+    # z does not reach x through detach
+    assert_almost_equal(x.grad.asnumpy(), [0.0])
+
+
+def test_stop_gradient_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + nd.stop_gradient(x * 4)
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [6.0])
+
+
+def test_training_flags():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+        assert not autograd.is_recording()
+
+
+def test_autograd_grad_api():
+    x = nd.array([1., 2., 3.])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+        g = autograd.grad(y, x, create_graph=False, retain_graph=True)
+    assert_almost_equal(g.asnumpy(), 3 * np.array([1, 4, 9.0]))
+
+
+def test_higher_order():
+    x = nd.array([1., 2., 3.])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+        g = autograd.grad(y, x, create_graph=True, retain_graph=True)
+        z = (g * g).sum()
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), 36 * np.array([1., 8., 27.]))
+
+
+def test_higher_order_sigmoid():
+    x = nd.array([0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sigmoid(x)
+        g = autograd.grad(y, x, create_graph=True, retain_graph=True)
+        z = g.sum()
+    z.backward()
+    s = 1 / (1 + np.exp(-0.5))
+    d2 = s * (1 - s) * (1 - 2 * s)
+    assert_almost_equal(x.grad.asnumpy(), [d2], rtol=1e-4, atol=1e-5)
+
+
+def test_unreached_variable_raises():
+    w = nd.ones((2,))
+    w.attach_grad()
+    x = nd.ones((2,))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * 2).sum()
+    with pytest.raises(mx.MXNetError):
+        autograd.grad(y, [w])
+
+
+def test_custom_function():
+    class ScaleGrad(autograd.Function):
+        def forward(self, x):
+            return x * 1.0
+
+        def backward(self, dy):
+            return dy * 7.0
+
+    x = nd.array([1., 2.])
+    x.attach_grad()
+    f = ScaleGrad()
+    with autograd.record():
+        y = f(x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [7, 7])
+
+
+def test_mark_variables():
+    x = nd.array([2.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables(x, g)
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(g.asnumpy(), [4.0])
+
+
+def test_exc_propagates_at_sync():
+    """Async error surfacing contract (reference test_exc_handling.py):
+    errors surface no later than the next sync point."""
+    with pytest.raises(Exception):
+        a = nd.array([1.0, 2.0])
+        b = nd.array([1.0, 2.0, 3.0])
+        c = nd.broadcast_add(a, b)  # incompatible shapes
+        c.asnumpy()

@@ -1,0 +1,230 @@
+"""Gluon tests (reference tests/python/unittest/test_gluon.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    return net
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(ctx=mx.cpu())
+    assert p.data().shape == (3, 4)
+    assert p.grad().shape == (3, 4)
+    p.set_data(nd.ones((3, 4)))
+    assert p.data().asnumpy().sum() == 12
+
+
+def test_parameter_deferred_init():
+    d = nn.Dense(8)
+    d.initialize()
+    with pytest.raises(Exception):
+        d.weight.data()  # deferred until first forward
+    x = nd.ones((2, 5))
+    d(x)
+    assert d.weight.shape == (8, 5)
+
+
+def test_collect_params_prefix_and_select():
+    net = _mlp()
+    params = net.collect_params()
+    names = list(params.keys())
+    assert all(n.startswith(net.prefix) for n in names)
+    ws = net.collect_params(".*weight")
+    assert all(n.endswith("weight") for n in ws.keys())
+
+
+def test_shared_params():
+    d1 = nn.Dense(8, in_units=4)
+    d2 = nn.Dense(8, in_units=4, params=d1.params)
+    d1.initialize()
+    x = nd.ones((2, 4))
+    assert_almost_equal(d1(x).asnumpy(), d2(x).asnumpy())
+
+
+def test_dense_flatten_modes():
+    d = nn.Dense(6, flatten=False)
+    d.initialize()
+    x = nd.ones((2, 3, 5))
+    assert d(x).shape == (2, 3, 6)
+
+
+def test_sequential_indexing():
+    net = _mlp()
+    assert len(net) == 2
+    assert isinstance(net[0], nn.Dense)
+    sub = net[0:1]
+    assert len(sub) == 1
+
+
+def test_hybridize_parity():
+    net = _mlp()
+    net.initialize()
+    x = nd.array(np.random.randn(4, 10).astype("float32"))
+    out1 = net(x).asnumpy()
+    net.hybridize()
+    out2 = net(x).asnumpy()
+    assert_almost_equal(out1, out2, rtol=1e-5, atol=1e-5)
+
+
+def test_hybridize_grad_parity():
+    x = nd.array(np.random.randn(4, 10).astype("float32"))
+
+    def grads(hybrid):
+        mx.random.seed(3)
+        net = _mlp()
+        net.initialize()
+        if hybrid:
+            net.hybridize()
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        return {k: p.grad().asnumpy()
+                for k, p in net.collect_params().items()}
+
+    g_imp = grads(False)
+    g_hyb = grads(True)
+    for k in g_imp:
+        ki = k.split("_", 1)[1]
+        match = [kk for kk in g_hyb if kk.split("_", 1)[1] == ki]
+        assert match, f"missing param {k}"
+        assert_almost_equal(g_imp[k], g_hyb[match[0]], rtol=1e-4, atol=1e-4,
+                            names=(k, match[0]))
+
+
+def test_trainer_sgd_training_converges():
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = _mlp()
+    net.initialize(init="xavier")
+    x = nd.array(np.random.randn(32, 10).astype("float32"))
+    y = nd.array(np.random.randint(0, 4, (32,)))
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01})
+    first = last = None
+    for i in range(20):
+        with autograd.record():
+            L = lossf(net(x), y).mean()
+        L.backward()
+        tr.step(1)
+        v = float(L.asnumpy())
+        first = v if first is None else first
+        last = v
+    assert last < first * 0.7
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = _mlp()
+    net.initialize()
+    x = nd.ones((2, 10))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    with autograd.record():
+        L = net(x).sum()
+    L.backward()
+    tr.step(1)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    tr.load_states(f)
+
+
+def test_save_load_parameters(tmp_path):
+    net = _mlp()
+    net.initialize()
+    x = nd.ones((2, 10))
+    ref = net(x).asnumpy()
+    f = str(tmp_path / "p.params")
+    net.save_parameters(f)
+    net2 = _mlp()
+    net2.load_parameters(f)
+    assert_almost_equal(net2(x).asnumpy(), ref)
+
+
+def test_block_repr_and_children():
+    net = _mlp()
+    r = repr(net)
+    assert "Dense" in r
+    assert len(net._children) == 2
+
+
+def test_losses():
+    pred = nd.array(np.random.randn(4, 5).astype("float32"))
+    label = nd.array(np.random.randint(0, 5, (4,)))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    p = pred.asnumpy()
+    lp = p - np.log(np.exp(p - p.max(-1, keepdims=True)).sum(
+        -1, keepdims=True)) - p.max(-1, keepdims=True)
+    ref = -lp[np.arange(4), label.asnumpy().astype(int)]
+    assert_almost_equal(l.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+    a = nd.array(np.random.randn(4, 3).astype("float32"))
+    b = nd.array(np.random.randn(4, 3).astype("float32"))
+    assert_almost_equal(
+        gluon.loss.L2Loss()(a, b).asnumpy(),
+        0.5 * ((a.asnumpy() - b.asnumpy()) ** 2).mean(axis=1),
+        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(
+        gluon.loss.L1Loss()(a, b).asnumpy(),
+        np.abs(a.asnumpy() - b.asnumpy()).mean(axis=1),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_runs():
+    pred = nd.array(np.random.uniform(-1, 1, (2, 10, 5)).astype("float32"))
+    label = nd.array(np.array([[1, 2, 0], [2, 3, 4]], dtype="float32"))
+    loss = gluon.loss.CTCLoss()(pred, label)
+    assert loss.shape == (2,)
+    assert np.isfinite(loss.asnumpy()).all()
+
+
+def test_constant_param():
+    class Net(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.c = self.params.get_constant("c", [[1.0, 2.0]])
+
+        def hybrid_forward(self, F, x, c):
+            return x * c
+
+    net = Net()
+    net.initialize()
+    out = net(nd.ones((2, 2)))
+    assert_almost_equal(out.asnumpy(), [[1, 2], [1, 2]])
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 6)
+    emb.initialize()
+    out = emb(nd.array(np.array([1, 2, 3])))
+    assert out.shape == (3, 6)
+
+
+def test_batchnorm_layer_global_stats():
+    bn = nn.BatchNorm(use_global_stats=True, in_channels=3)
+    bn.initialize()
+    x = nd.array(np.random.randn(2, 3, 4, 4).astype("float32"))
+    out = bn(x)  # uses running stats (0 mean, 1 var)
+    assert_almost_equal(out.asnumpy(), x.asnumpy(), rtol=1e-2, atol=1e-2)
+
+
+def test_apply_and_hooks():
+    net = _mlp()
+    net.initialize()
+    seen = []
+    net.apply(lambda b: seen.append(type(b).__name__))
+    assert "Dense" in seen and "HybridSequential" in seen
+    calls = []
+    net.register_forward_hook(lambda blk, inp, out: calls.append(1))
+    net(nd.ones((1, 10)))
+    assert calls

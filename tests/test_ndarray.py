@@ -1,0 +1,228 @@
+"""NDArray semantics tests (reference tests/python/unittest/test_ndarray.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert nd.zeros((3, 4)).asnumpy().sum() == 0
+    assert nd.ones((3, 4)).asnumpy().sum() == 12
+    assert_almost_equal(nd.full((2, 2), 7).asnumpy(), np.full((2, 2), 7.0))
+    assert_almost_equal(nd.arange(0, 10, 2).asnumpy(), np.arange(0, 10, 2))
+
+
+def test_python_float_default_dtype():
+    a = nd.array([1.5, 2.5])
+    assert a.dtype == np.float32  # reference: float64 source → float32
+
+
+def test_arithmetic():
+    a = nd.array([[1., 2.], [3., 4.]])
+    b = nd.array([[5., 6.], [7., 8.]])
+    assert_almost_equal((a + b).asnumpy(), [[6, 8], [10, 12]])
+    assert_almost_equal((a - b).asnumpy(), [[-4, -4], [-4, -4]])
+    assert_almost_equal((a * b).asnumpy(), [[5, 12], [21, 32]])
+    assert_almost_equal((b / a).asnumpy(), [[5, 3], [7 / 3, 2]])
+    assert_almost_equal((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    assert_almost_equal((2 + a).asnumpy(), [[3, 4], [5, 6]])
+    assert_almost_equal((2 - a).asnumpy(), [[1, 0], [-1, -2]])
+    assert_almost_equal((2 / a).asnumpy(), [[2, 1], [2 / 3, 0.5]])
+    assert_almost_equal((-a).asnumpy(), [[-1, -2], [-3, -4]])
+    assert_almost_equal(abs(-a).asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_comparison():
+    a = nd.array([1., 2., 3.])
+    b = nd.array([3., 2., 1.])
+    assert_almost_equal((a == b).asnumpy(), [0, 1, 0])
+    assert_almost_equal((a < b).asnumpy(), [1, 0, 0])
+    assert_almost_equal((a >= b).asnumpy(), [0, 1, 1])
+
+
+def test_inplace_ops():
+    a = nd.array([1., 2., 3.])
+    a += 1
+    assert_almost_equal(a.asnumpy(), [2, 3, 4])
+    a *= 2
+    assert_almost_equal(a.asnumpy(), [4, 6, 8])
+    a /= 4
+    assert_almost_equal(a.asnumpy(), [1, 1.5, 2])
+
+
+def test_setitem():
+    a = nd.zeros((3, 4))
+    a[:] = 2
+    assert a.asnumpy().sum() == 24
+    a[1] = 5
+    assert_almost_equal(a.asnumpy()[1], np.full(4, 5.0))
+    a[0, 1:3] = 7
+    assert_almost_equal(a.asnumpy()[0], [2, 7, 7, 2])
+    a[2] = np.array([1, 2, 3, 4])
+    assert_almost_equal(a.asnumpy()[2], [1, 2, 3, 4])
+
+
+def test_view_aliasing():
+    """Basic-index views share storage both directions (reference NDArray
+    Slice/At semantics)."""
+    a = nd.array(np.arange(12).reshape(3, 4).astype("float32"))
+    v = a[1]
+    a[1] = 99.0
+    assert_almost_equal(v.asnumpy(), np.full(4, 99.0))
+    v[:] = 7.0
+    assert_almost_equal(a.asnumpy()[1], np.full(4, 7.0))
+    r = a.reshape(4, 3)
+    r[0, 0] = -1.0
+    assert a.asnumpy()[0, 0] == -1.0
+
+
+def test_advanced_indexing_copies():
+    a = nd.array(np.arange(6).astype("float32"))
+    c = a[np.array([0, 2, 4])]
+    assert_almost_equal(c.asnumpy(), [0, 2, 4])
+    c[:] = 9
+    assert a.asnumpy()[0] == 0  # copy, not view
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, -1, 3, 4)).shape == (2, 1, 3, 4)
+
+
+def test_reductions():
+    x = np.random.uniform(-1, 1, (3, 4, 5)).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(a.sum().asnumpy(), x.sum())
+    assert_almost_equal(a.mean(axis=1).asnumpy(), x.mean(axis=1))
+    assert_almost_equal(a.max(axis=(0, 2)).asnumpy(), x.max(axis=(0, 2)))
+    assert_almost_equal(nd.sum(a, axis=1, keepdims=True).asnumpy(),
+                        x.sum(axis=1, keepdims=True))
+    assert_almost_equal(nd.sum(a, axis=1, exclude=True).asnumpy(),
+                        x.sum(axis=(0, 2)))
+
+
+def test_dot():
+    x = np.random.uniform(-1, 1, (4, 5)).astype("float32")
+    y = np.random.uniform(-1, 1, (5, 3)).astype("float32")
+    assert_almost_equal(nd.dot(nd.array(x), nd.array(y)).asnumpy(),
+                        x.dot(y), rtol=1e-4, atol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(x), nd.array(y.T), transpose_b=True).asnumpy(),
+        x.dot(y), rtol=1e-4, atol=1e-4)
+    bx = np.random.uniform(-1, 1, (2, 4, 5)).astype("float32")
+    by = np.random.uniform(-1, 1, (2, 5, 3)).astype("float32")
+    assert_almost_equal(nd.batch_dot(nd.array(bx), nd.array(by)).asnumpy(),
+                        np.matmul(bx, by), rtol=1e-4, atol=1e-4)
+
+
+def test_shape_ops():
+    x = np.arange(24).reshape(2, 3, 4).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(a.transpose().asnumpy(), x.T)
+    assert_almost_equal(a.transpose((1, 0, 2)).asnumpy(),
+                        x.transpose(1, 0, 2))
+    assert_almost_equal(a.swapaxes(0, 2).asnumpy(), x.swapaxes(0, 2))
+    assert_almost_equal(a.expand_dims(1).asnumpy(), x[:, None])
+    assert_almost_equal(nd.concat(a, a, dim=1).asnumpy(),
+                        np.concatenate([x, x], axis=1))
+    assert_almost_equal(nd.stack(a, a, axis=0).asnumpy(),
+                        np.stack([x, x]))
+    assert_almost_equal(nd.flip(a, axis=2).asnumpy(), x[:, :, ::-1])
+    assert_almost_equal(nd.tile(a, reps=(1, 2, 1)).asnumpy(),
+                        np.tile(x, (1, 2, 1)))
+
+
+def test_slice_ops():
+    x = np.arange(24).reshape(4, 6).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(a.slice([1, 2], [3, 5]).asnumpy(), x[1:3, 2:5])
+    assert_almost_equal(a.slice_axis(1, 2, 4).asnumpy(), x[:, 2:4])
+    parts = nd.split(a, num_outputs=2, axis=0)
+    assert_almost_equal(parts[0].asnumpy(), x[:2])
+
+
+def test_take_pick_onehot():
+    x = np.random.uniform(size=(4, 5)).astype("float32")
+    a = nd.array(x)
+    idx = nd.array(np.array([0, 2]))
+    assert_almost_equal(a.take(idx, axis=0).asnumpy(), x[[0, 2]])
+    picked = a.pick(nd.array(np.array([1, 0, 3, 2])), axis=1)
+    assert_almost_equal(picked.asnumpy(), x[np.arange(4), [1, 0, 3, 2]])
+    oh = nd.one_hot(nd.array(np.array([0, 2])), depth=4)
+    assert_almost_equal(oh.asnumpy(), np.eye(4)[[0, 2]])
+
+
+def test_ordering():
+    x = np.random.uniform(size=(3, 6)).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(a.sort(axis=1).asnumpy(), np.sort(x, axis=1))
+    assert_almost_equal(a.argsort(axis=1).asnumpy(),
+                        np.argsort(x, axis=1).astype("float32"))
+    v = a.topk(k=2, ret_typ="value", axis=1)
+    assert_almost_equal(v.asnumpy(), -np.sort(-x, axis=1)[:, :2])
+    am = a.argmax(axis=1)
+    assert_almost_equal(am.asnumpy(), np.argmax(x, axis=1).astype("float32"))
+
+
+def test_astype_copy():
+    a = nd.array([1.5, 2.5])
+    b = a.astype(np.int32)
+    assert b.dtype == np.int32
+    assert a.astype(np.float32, copy=False) is a
+
+
+def test_scalar_conversions():
+    a = nd.array([3.5])
+    assert float(a) == 3.5
+    assert int(a) == 3
+    assert a.asscalar() == pytest.approx(3.5)
+    with pytest.raises(Exception):
+        nd.array([1.0, 2.0]).asscalar()
+
+
+def test_save_load_roundtrip(tmp_path):
+    fname = str(tmp_path / "x.params")
+    d = {"w": nd.array(np.random.randn(3, 4).astype("float32")),
+         "b": nd.array(np.random.randn(4).astype("float32"))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"].asnumpy(), d["w"].asnumpy())
+    lst = [nd.array([1.0]), nd.array([2.0, 3.0])]
+    nd.save(fname, lst)
+    back = nd.load(fname)
+    assert isinstance(back, list) and len(back) == 2
+    assert_almost_equal(back[1].asnumpy(), [2.0, 3.0])
+
+
+def test_wait_and_context():
+    a = nd.ones((2, 2))
+    a.wait_to_read()
+    assert a.ctx.device_type == "cpu"
+    b = a.as_in_context(mx.cpu(0))
+    assert b is a
+    nd.waitall()
+
+
+def test_iter_len():
+    a = nd.array(np.arange(6).reshape(3, 2).astype("float32"))
+    assert len(a) == 3
+    rows = [r.asnumpy() for r in a]
+    assert len(rows) == 3
+    assert_almost_equal(rows[1], [2, 3])
+
+
+def test_zeros_like_ones_like():
+    a = nd.array(np.random.randn(2, 3).astype("float32"))
+    assert nd.zeros_like(a).asnumpy().sum() == 0
+    assert nd.ones_like(a).asnumpy().sum() == 6

@@ -1,0 +1,317 @@
+"""Operator numeric checks (reference tests/python/unittest/test_operator.py).
+
+Pattern preserved: each op checked against a numpy reference; gradients via
+check_numeric_gradient for representative ops (finite differences vs the
+autograd path — SURVEY §4.2 numeric oracles)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient)
+import scipy.special as sps
+
+
+UNARY_CASES = [
+    ("relu", lambda x: np.maximum(x, 0)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("tanh", np.tanh),
+    ("exp", np.exp),
+    ("log", lambda x: np.log(np.abs(x) + 1.1)),
+    ("sqrt", lambda x: np.sqrt(np.abs(x) + 1.0)),
+    ("square", np.square),
+    ("abs", np.abs),
+    ("floor", np.floor),
+    ("erf", sps.erf),
+    ("gammaln", lambda x: sps.gammaln(np.abs(x) + 1.0)),
+]
+
+
+@pytest.mark.parametrize("name,ref", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary(name, ref):
+    x = np.random.uniform(-2, 2, (3, 4)).astype("float32")
+    if name in ("log", "sqrt"):
+        xin = np.abs(x) + (1.1 if name == "log" else 1.0)
+    elif name == "gammaln":
+        xin = np.abs(x) + 1.0
+    else:
+        xin = x
+    out = getattr(nd, name)(nd.array(xin)).asnumpy()
+    assert_almost_equal(out, ref(x) if name not in ("log", "sqrt", "gammaln")
+                        else ref(x), rtol=1e-4, atol=1e-4)
+
+
+def test_broadcast_binary():
+    a = np.random.uniform(-2, 2, (3, 1, 4)).astype("float32")
+    b = np.random.uniform(0.5, 2, (1, 5, 4)).astype("float32")
+    na, nb = nd.array(a), nd.array(b)
+    assert_almost_equal(nd.broadcast_add(na, nb).asnumpy(), a + b)
+    assert_almost_equal(nd.broadcast_mul(na, nb).asnumpy(), a * b)
+    assert_almost_equal(nd.broadcast_div(na, nb).asnumpy(), a / b)
+    assert_almost_equal(nd.broadcast_maximum(na, nb).asnumpy(),
+                        np.maximum(a, b))
+    assert_almost_equal(nd.broadcast_power(nb, nb).asnumpy(), b ** b,
+                        rtol=1e-3, atol=1e-3)
+
+
+def test_softmax_family():
+    x = np.random.uniform(-3, 3, (4, 7)).astype("float32")
+    ex = np.exp(x - x.max(-1, keepdims=True))
+    sm = ex / ex.sum(-1, keepdims=True)
+    assert_almost_equal(nd.softmax(nd.array(x)).asnumpy(), sm, rtol=1e-4,
+                        atol=1e-5)
+    assert_almost_equal(nd.log_softmax(nd.array(x)).asnumpy(), np.log(sm),
+                        rtol=1e-4, atol=1e-4)
+    t = 2.0
+    ext = np.exp(x / t - (x / t).max(-1, keepdims=True))
+    assert_almost_equal(nd.softmax(nd.array(x), temperature=t).asnumpy(),
+                        ext / ext.sum(-1, keepdims=True), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_fully_connected():
+    x = np.random.uniform(-1, 1, (5, 3, 4)).astype("float32")
+    w = np.random.uniform(-1, 1, (8, 12)).astype("float32")
+    b = np.random.uniform(-1, 1, (8,)).astype("float32")
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b),
+                            num_hidden=8)
+    ref = x.reshape(5, 12).dot(w.T) + b
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(
+        np.random.uniform(-1, 1, (8, 4)).astype("float32")), None,
+        num_hidden=8, flatten=False, no_bias=True)
+    assert out2.shape == (5, 3, 8)
+
+
+def test_convolution_vs_explicit():
+    import jax
+    x = np.random.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+    w = np.random.uniform(-1, 1, (4, 3, 3, 3)).astype("float32")
+    b = np.zeros((4,), "float32")
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), num_filter=4).asnumpy()
+    # direct correlation reference
+    ref = np.zeros((2, 4, 6, 6), "float32")
+    for n in range(2):
+        for f in range(4):
+            for i in range(6):
+                for j in range(6):
+                    ref[n, f, i, j] = (x[n, :, i:i + 3, j:j + 3]
+                                       * w[f]).sum()
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_convolution_grouped_strided():
+    x = np.random.uniform(-1, 1, (2, 4, 9, 9)).astype("float32")
+    w = np.random.uniform(-1, 1, (6, 2, 3, 3)).astype("float32")
+    out = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(3, 3),
+                         num_filter=6, num_group=2, stride=(2, 2),
+                         pad=(1, 1), no_bias=True)
+    assert out.shape == (2, 6, 5, 5)
+
+
+def test_deconvolution_shape():
+    x = np.random.uniform(-1, 1, (2, 4, 5, 5)).astype("float32")
+    w = np.random.uniform(-1, 1, (4, 3, 3, 3)).astype("float32")
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           num_filter=3, stride=(2, 2), no_bias=True)
+    assert out.shape == (2, 3, 11, 11)
+
+
+def test_pooling():
+    x = np.random.uniform(-1, 1, (2, 3, 6, 6)).astype("float32")
+    mp = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                    pool_type="max").asnumpy()
+    ref = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+    assert_almost_equal(mp, ref)
+    ap = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                    pool_type="avg").asnumpy()
+    assert_almost_equal(ap, x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5)),
+                        rtol=1e-5, atol=1e-6)
+    gp = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg").asnumpy()
+    assert_almost_equal(gp[:, :, 0, 0], x.mean(axis=(2, 3)), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_batchnorm_train_stats():
+    x = np.random.uniform(-1, 1, (8, 4, 3, 3)).astype("float32")
+    gamma = np.ones(4, "float32")
+    beta = np.zeros(4, "float32")
+    mm = nd.zeros((4,))
+    mv = nd.ones((4,))
+    from mxnet_tpu import autograd
+    with autograd.train_mode():
+        out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                           mm, mv, fix_gamma=False, momentum=0.9)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = (x - mean[None, :, None, None]) / np.sqrt(
+        var[None, :, None, None] + 1e-3)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-3, atol=1e-3)
+    assert_almost_equal(mm.asnumpy(), 0.1 * mean, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(mv.asnumpy(), 0.9 + 0.1 * var, rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm():
+    x = np.random.uniform(-1, 1, (4, 6)).astype("float32")
+    g = np.random.uniform(0.5, 1.5, (6,)).astype("float32")
+    b = np.random.uniform(-0.5, 0.5, (6,)).astype("float32")
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b)).asnumpy()
+    mu = x.mean(-1, keepdims=True)
+    sig = x.var(-1, keepdims=True)
+    assert_almost_equal(out, (x - mu) / np.sqrt(sig + 1e-5) * g + b,
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_gradients_numeric():
+    check_numeric_gradient(lambda x: (x * x).sum(),
+                           [np.random.uniform(-1, 1, (3, 3)).astype("float32")])
+    check_numeric_gradient(lambda x: nd.tanh(x).sum(),
+                           [np.random.uniform(-1, 1, (4,)).astype("float32")])
+    check_numeric_gradient(
+        lambda a, b: nd.dot(a, b).sum(),
+        [np.random.uniform(-1, 1, (3, 4)).astype("float32"),
+         np.random.uniform(-1, 1, (4, 2)).astype("float32")])
+
+
+def test_embedding_and_grad():
+    from mxnet_tpu import autograd
+    w = nd.array(np.random.uniform(-1, 1, (10, 4)).astype("float32"))
+    w.attach_grad()
+    idx = nd.array(np.array([1, 3, 1]))
+    with autograd.record():
+        out = nd.Embedding(idx, w, input_dim=10, output_dim=4)
+        loss = out.sum()
+    loss.backward()
+    g = w.grad.asnumpy()
+    assert g[1].sum() == pytest.approx(8.0)  # row 1 hit twice
+    assert g[3].sum() == pytest.approx(4.0)
+    assert g[0].sum() == 0
+
+
+def test_where_clip():
+    x = np.random.uniform(-2, 2, (3, 4)).astype("float32")
+    c = (x > 0).astype("float32")
+    out = nd.where(nd.array(c), nd.array(x), nd.array(-x))
+    assert_almost_equal(out.asnumpy(), np.abs(x))
+    assert_almost_equal(nd.clip(nd.array(x), a_min=-1, a_max=1).asnumpy(),
+                        np.clip(x, -1, 1))
+
+
+def test_gather_scatter_nd():
+    x = np.random.uniform(size=(3, 4)).astype("float32")
+    idx = np.array([[0, 2], [1, 3]])
+    out = nd.gather_nd(nd.array(x), nd.array(idx))
+    assert_almost_equal(out.asnumpy(), x[[0, 2], [1, 3]])
+    sc = nd.scatter_nd(nd.array(np.array([5.0, 6.0], "float32")),
+                       nd.array(idx), shape=(3, 4))
+    ref = np.zeros((3, 4), "float32")
+    ref[0, 1] = 5
+    ref[2, 3] = 6
+    assert_almost_equal(sc.asnumpy(), ref)
+
+
+def test_sequence_ops():
+    x = np.random.uniform(size=(4, 3, 2)).astype("float32")  # (T, N, C)
+    slen = np.array([2, 4, 1], "float32")
+    masked = nd.sequence_mask(nd.array(x), nd.array(slen),
+                              use_sequence_length=True, value=-1.0)
+    m = masked.asnumpy()
+    assert (m[2:, 0] == -1).all() and (m[1:, 2] == -1).all()
+    assert (m[:, 1] == x[:, 1]).all()
+    last = nd.sequence_last(nd.array(x), nd.array(slen),
+                            use_sequence_length=True)
+    assert_almost_equal(last.asnumpy()[0], x[1, 0])
+    rev = nd.sequence_reverse(nd.array(x), nd.array(slen),
+                              use_sequence_length=True)
+    assert_almost_equal(rev.asnumpy()[0, 0], x[1, 0])
+
+
+def test_rnn_lstm_shapes():
+    T, N, I, H, L = 5, 3, 4, 6, 2
+    ng = 4
+    size = 0
+    for l in range(L):
+        in_sz = I if l == 0 else H
+        size += ng * H * in_sz + ng * H * H + 2 * ng * H
+    params = nd.array(np.random.uniform(-0.1, 0.1, (size,)).astype("float32"))
+    x = nd.array(np.random.uniform(-1, 1, (T, N, I)).astype("float32"))
+    h0 = nd.zeros((L, N, H))
+    c0 = nd.zeros((L, N, H))
+    out = nd.RNN(x, params, h0, c0, state_size=H, num_layers=L, mode="lstm",
+                 state_outputs=True)
+    assert out[0].shape == (T, N, H)
+    assert out[1].shape == (L, N, H)
+    assert out[2].shape == (L, N, H)
+
+
+def test_interleaved_attention_consistency():
+    """qk/valatt fused ops == explicit attention math."""
+    L, B, H, D = 4, 2, 3, 5
+    qkv = np.random.uniform(-1, 1, (L, B, 3 * H * D)).astype("float32")
+    att = nd.contrib.interleaved_matmul_selfatt_qk(nd.array(qkv), heads=H)
+    assert att.shape == (B * H, L, L)
+    x = qkv.reshape(L, B, H, 3, D)
+    q, k, v = x[:, :, :, 0], x[:, :, :, 1], x[:, :, :, 2]
+    ref = np.einsum("qbhd,kbhd->bhqk", q / np.sqrt(D), k).reshape(B * H, L, L)
+    assert_almost_equal(att.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+    out = nd.contrib.interleaved_matmul_selfatt_valatt(
+        nd.array(qkv), att, heads=H)
+    ref_out = np.einsum("bhqk,kbhd->qbhd",
+                        ref.reshape(B, H, L, L), v).reshape(L, B, H * D)
+    assert_almost_equal(out.asnumpy(), ref_out, rtol=1e-4, atol=1e-4)
+
+
+def test_optimizer_ops_match_numpy():
+    w = np.random.uniform(-1, 1, (6,)).astype("float32")
+    g = np.random.uniform(-1, 1, (6,)).astype("float32")
+    out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01)
+    assert_almost_equal(out.asnumpy(), w - 0.1 * (g + 0.01 * w), rtol=1e-5,
+                        atol=1e-6)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    wn, mn, vn = nd.adam_update(nd.array(w), nd.array(g), nd.array(m),
+                                nd.array(v), lr=0.01)
+    m_ref = 0.1 * g
+    v_ref = 0.001 * g * g
+    assert_almost_equal(mn.asnumpy(), m_ref, rtol=1e-4, atol=1e-6)
+    assert_almost_equal(
+        wn.asnumpy(), w - 0.01 * m_ref / (np.sqrt(v_ref) + 1e-8),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_linalg():
+    a = np.random.uniform(0.5, 1.5, (3, 3)).astype("float32")
+    spd = a.dot(a.T) + 3 * np.eye(3, dtype="float32")
+    l = nd.linalg.potrf(nd.array(spd)).asnumpy()
+    assert_almost_equal(l.dot(l.T), spd, rtol=1e-3, atol=1e-3)
+    assert_almost_equal(nd.linalg.inverse(nd.array(spd)).asnumpy(),
+                        np.linalg.inv(spd), rtol=1e-2, atol=1e-3)
+    x = np.random.uniform(-1, 1, (2, 3, 4)).astype("float32")
+    y = np.random.uniform(-1, 1, (2, 4, 5)).astype("float32")
+    assert_almost_equal(
+        nd.linalg.gemm2(nd.array(x), nd.array(y)).asnumpy(),
+        np.matmul(x, y), rtol=1e-4, atol=1e-4)
+
+
+def test_softmax_output_backward():
+    from mxnet_tpu import autograd
+    x = nd.array(np.random.uniform(-1, 1, (4, 3)).astype("float32"))
+    label = nd.array(np.array([0, 1, 2, 1], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    p = out.asnumpy()
+    oh = np.eye(3)[[0, 1, 2, 1]]
+    assert_almost_equal(x.grad.asnumpy(), p - oh, rtol=1e-4, atol=1e-5)
+
+
+def test_boolean_mask():
+    x = np.random.uniform(size=(5, 3)).astype("float32")
+    mask = np.array([1, 0, 1, 0, 1], "float32")
+    out = nd.boolean_mask(nd.array(x), nd.array(mask))
+    assert_almost_equal(out.asnumpy(), x[[0, 2, 4]])
