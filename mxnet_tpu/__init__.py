@@ -26,13 +26,24 @@ from . import config  # noqa: F401
 def _apply_matmul_precision():
     # float32 means float32 (MXNet numerics): the XLA default lets f32
     # dots run in reduced precision; raise it globally unless overridden.
+    # mxnet_tpu.amp flips this to bf16-first policies at runtime.
     prec = config.get("MXNET_TPU_DEFAULT_MATMUL_PRECISION", "highest")
     if prec and prec != "default":
         import jax
         jax.config.update("jax_default_matmul_precision", prec)
 
 
+def _apply_x64():
+    # the reference supports float64 NDArrays end-to-end; JAX canonicalizes
+    # f64→f32 unless x64 is on.  Explicit float32 (our default dtype)
+    # is unaffected by this flag.
+    if config.get("MXNET_TPU_ENABLE_X64", "1") == "1":
+        import jax
+        jax.config.update("jax_enable_x64", True)
+
+
 _apply_matmul_precision()
+_apply_x64()
 
 from .base import MXNetError  # noqa: F401
 from .context import (  # noqa: F401
@@ -67,10 +78,20 @@ def __getattr__(name):
     lazies = {"gluon", "optimizer", "metric", "initializer", "lr_scheduler",
               "io", "image", "kvstore", "profiler", "runtime", "symbol",
               "parallel", "test_utils", "recordio", "callback", "model",
-              "util", "numpy", "numpy_extension", "contrib", "models"}
+              "util", "numpy", "numpy_extension", "contrib", "amp", "module",
+              "monitor"}
     if name in lazies:
         mod = _lazy(name)
         globals()[name] = mod
+        return mod
+    # reference canonical short names
+    if name == "sym":
+        mod = _lazy("symbol")
+        globals()["sym"] = mod
+        return mod
+    if name == "mod":
+        mod = _lazy("module")
+        globals()["mod"] = mod
         return mod
     if name == "np":
         mod = _lazy("numpy")

@@ -213,6 +213,11 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
             continue
         any_node = True
         n, idx = node
+        if n.vjp_fn is None and n.inputs is None:
+            raise MXNetError(
+                "cannot run backward twice through the same graph: the tape "
+                "was freed by the previous backward() (pass retain_graph=True "
+                "to keep it, matching the reference contract)")
         if n.grads is None:
             n.grads = [None] * len(n.out_avals)
         seed = _mk_seed(h, hg)

@@ -446,22 +446,28 @@ class HybridBlock(Block):
 
     def export(self, path, epoch=0):
         """Serialize compiled graph + params (reference HybridBlock.export →
-        symbol json + .params pair; here StableHLO text + .params)."""
+        symbol json + .params pair; here real StableHLO text + .params).
+
+        The block must have been hybridized and called at least once so a
+        compiled cache entry exists (same precondition as the reference)."""
+        import jax
         params = list(self.collect_params().values())
         fname_params = f"{path}-{epoch:04d}.params"
         nd.save(fname_params, {p.name: p.data() for p in params})
-        hlo = ""
-        if self._cached_op and self._cached_op._cache:
-            import jax
-            jitted, _, _ = next(iter(self._cached_op._cache.values()))
-            try:
-                # re-lower from the cached jit using the concrete params
-                key0 = jax.random.PRNGKey(0)
-                hlo = "(compiled; shapes cached — see .params for weights)"
-            except Exception:
-                hlo = ""
+        if not (self._cached_op and self._cached_op._cache):
+            raise MXNetError(
+                "export() requires hybridize() and at least one forward call "
+                "(reference raises on un-hybridized export)")
+        cache_key, entry = next(iter(self._cached_op._cache.items()))
+        jitted = entry[0]
+        # cache key = ((shape, dtype_str) per input..., train_mode, kwargs)
+        in_specs = [jax.ShapeDtypeStruct(s, _np.dtype(d))
+                    for s, d in cache_key[:-2]]
+        lowered = jitted.lower(jax.random.PRNGKey(0),
+                               *[p.data()._data for p in params], *in_specs)
+        hlo = lowered.as_text()
         with open(f"{path}-symbol.txt", "w") as f:
-            f.write(f"mxnet_tpu StableHLO export for {self.name}\n{hlo}\n")
+            f.write(hlo)
         return fname_params
 
 

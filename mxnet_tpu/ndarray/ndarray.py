@@ -379,14 +379,18 @@ class NDArray:
 
     __hash__ = object.__hash__  # identity hash, reference parity
 
-    # in-place ops: under recording they rebind functionally (safe for tape);
-    # outside they mutate the slot (reference engine-ordered write).
+    # in-place ops always mutate the slot so every alias observes the write
+    # (reference engine-ordered write); under recording, writes to arrays on
+    # the tape raise, matching __setitem__.  If the *operand* was recorded,
+    # the result's tape node is carried so gradient still flows through.
     def _iop(self, opname, scalar_op, other):
         from .. import autograd
         if autograd.is_recording():
-            return self._op2(opname, other, scalar_op)
+            self._check_writable()
         res = self._op2(opname, other, scalar_op)
         self._set_data(res._data)
+        if res._node is not None:
+            self._node = res._node
         return self
 
     def __iadd__(self, o):
@@ -722,12 +726,9 @@ def array(source_array, ctx=None, dtype=None):
         src = _np.asarray(source_array)
     if dtype is None:
         # reference default: python lists/scalars land as float32
-        # (mx_real_t); numpy sources keep their dtype except float64
-        if not was_np or src.dtype == _np.float64:
-            dtype = mx_real_t if src.dtype.kind == "f" or not was_np \
-                else src.dtype
-        else:
-            dtype = src.dtype
+        # (mx_real_t); numpy/NDArray sources keep their dtype — including
+        # float64 (silent downcast would lose precision for porting users)
+        dtype = src.dtype if was_np else mx_real_t
     src = src.astype(dtype_from_any(dtype), copy=False)
     arr, ctx = _put(src, ctx)
     return NDArray._from_data(arr, ctx=ctx)

@@ -114,14 +114,20 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
+    @staticmethod
+    def _is_half(dtype):
+        # float16 AND bfloat16 (the TPU-native half) get fp32 master copies
+        dt = _np.dtype(dtype)
+        return dt.kind == "f" and dt.itemsize == 2 or dt.name == "bfloat16"
+
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype == _np.float16:
+        if self.multi_precision and self._is_half(weight.dtype):
             master = weight.astype(_np.float32)
             return (master, self.create_state(index, master))
         return self.create_state(index, weight)
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == _np.float16:
+        if self.multi_precision and self._is_half(weight.dtype):
             master, base_state = state
             self.update(index, master, grad.astype(_np.float32), base_state)
             weight._set_data(master.astype(weight.dtype)._data)

@@ -167,3 +167,29 @@ def test_exc_propagates_at_sync():
         b = nd.array([1.0, 2.0, 3.0])
         c = nd.broadcast_add(a, b)  # incompatible shapes
         c.asnumpy()
+
+
+def test_double_backward_raises():
+    """ADVICE r1: second backward on a freed graph raises, not silent no-op."""
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    with pytest.raises(mx.MXNetError):
+        y.backward()
+    # retain_graph=True permits a second pass
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+
+
+def test_inplace_on_recorded_raises():
+    """ADVICE r1: += on an array that is an output of recorded compute."""
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with pytest.raises(mx.MXNetError):
+            y += 1

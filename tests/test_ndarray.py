@@ -226,3 +226,25 @@ def test_zeros_like_ones_like():
     a = nd.array(np.random.randn(2, 3).astype("float32"))
     assert nd.zeros_like(a).asnumpy().sum() == 0
     assert nd.ones_like(a).asnumpy().sum() == 6
+
+
+def test_inplace_alias_visibility():
+    """ADVICE r1: a += b must mutate the slot so aliases observe the write."""
+    a = nd.array([1.0, 1.0])
+    alias = a
+    a += 1
+    assert_almost_equal(alias.asnumpy(), [2.0, 2.0])
+    # through a view too
+    v = a[0:2]
+    a += 1
+    assert_almost_equal(v.asnumpy(), [3.0, 3.0])
+
+
+def test_array_preserves_float64():
+    """ADVICE r1: numpy float64 sources keep their dtype."""
+    src = np.array([1.0, 2.0], dtype=np.float64)
+    a = nd.array(src)
+    assert a.dtype == np.float64
+    assert_almost_equal(a.asnumpy(), src)
+    # python lists still default to float32
+    assert nd.array([1.0, 2.0]).dtype == np.float32
