@@ -232,18 +232,21 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
                 # record the backward ops onto the SAME tape (no reset) so
                 # higher-order chains stay connected through original nodes
                 with _keep_tape_recording():
-                    _run_tape_backward(tape, create_graph=True)
+                    visited = _run_tape_backward(tape, create_graph=True)
             else:
-                _run_tape_backward(tape, create_graph=False)
+                visited = _run_tape_backward(tape, create_graph=False)
     finally:
         s.create_graph_mode = False
 
     if not retain_graph and not create_graph:
-        for n in tape:
+        # free only the subgraph this backward visited: per-device losses
+        # recorded on the same tape (the reference's multi-ctx idiom
+        # ``for l in losses: l.backward()``) keep their own nodes alive
+        for n in visited:
             n.vjp_fn = None  # free residuals
             n.inputs = None
         if s.tape is tape:
-            s.tape = []
+            s.tape = [n for n in tape if n not in visited]
     else:
         for n in tape:
             n.grads = None
@@ -263,10 +266,20 @@ def _keep_tape_recording():
         s.session_depth -= 1
 
 
+def _freed(node):
+    return node.vjp_fn is None and node.inputs is None
+
+
 def _run_tape_backward(tape, create_graph=False):
+    visited = set()
     for n in reversed(tape):
         if n.grads is None or all(g is None for g in n.grads):
             continue
+        if _freed(n):
+            raise MXNetError(
+                "cannot run backward through a subgraph already freed by a "
+                "previous backward() (pass retain_graph=True to keep it)")
+        visited.add(n)
         if create_graph:
             in_grads = _recorded_vjp_call(n)
         else:
@@ -281,11 +294,19 @@ def _run_tape_backward(tape, create_graph=False):
                 entry[1]._accumulate_grad(g)
             else:  # ("node", node, idx)
                 _, pnode, pidx = entry
+                if _freed(pnode):
+                    # the producer was freed by an earlier backward (it may
+                    # even be off the tape): silent gradient loss otherwise
+                    raise MXNetError(
+                        "cannot run backward: a shared subgraph was freed by "
+                        "a previous backward() (pass retain_graph=True, or "
+                        "call backward once on the combined heads)")
                 if pnode.grads is None:
                     pnode.grads = [None] * len(pnode.out_avals)
                 pnode.grads[pidx] = (g if pnode.grads[pidx] is None
                                      else pnode.grads[pidx] + g)
         n.grads = None
+    return visited
 
 
 def _recorded_vjp_call(node):

@@ -331,6 +331,9 @@ class CachedOp:
         from ..ops import registry as _reg
         from .. import random as _rnd
 
+        # select the param replica co-located with the inputs (multi-ctx DP);
+        # the trace itself is ctx-agnostic (same shapes) and shared
+        ctx = next((a.ctx for a in input_nds), None)
         in_arrays = [a._data for a in input_nds]
         key = tuple((tuple(a.shape), str(a.dtype)) for a in in_arrays) \
             + (train_mode, tuple(sorted(kwargs.items())))
@@ -359,7 +362,7 @@ class CachedOp:
                      mutate_inputs=tuple(
                          (n_out + j, mutated_idx[j]) for j in range(n_mut)),
                      wrap_key="_key" if uses_rng else None, jit=False)
-        p_nds = [p.data() for p in param_list]
+        p_nds = [p.data(ctx) for p in param_list]
         res = _reg.invoke(op, p_nds + input_nds, {})
         return res
 
@@ -404,14 +407,18 @@ class HybridBlock(Block):
                 f"parameters {pending}; initialize them explicitly")
 
     def hybrid_forward_dispatch(self, *args, **kwargs):
-        """Call user hybrid_forward with F + param kwargs (imperative F)."""
+        """Call user hybrid_forward with F + param kwargs (imperative F).
+        Params are selected by the input's context so multi-ctx data
+        parallelism uses the replica living with the data (reference
+        passes ctx through DataParallel executor groups)."""
         pending = [p for p in self._reg_params.values()
                    if p._data is None and p._deferred_init is not None]
         if pending:
             self.infer_param_shapes(args)
             for p in pending:
                 p._finish_deferred_init()
-        params = {name: p.data() for name, p in self._reg_params.items()}
+        ctx = next((a.ctx for a in args if isinstance(a, NDArray)), None)
+        params = {name: p.data(ctx) for name, p in self._reg_params.items()}
         return self.hybrid_forward(nd, *args, **params, **kwargs)
 
     def hybrid_forward(self, F, x, *args, **kwargs):

@@ -45,7 +45,8 @@ class Parameter:
         self.stype = stype
         self.grad_stype = grad_stype
         self.sharding = sharding  # TPU: PartitionSpec axes hint for pjit
-        self._data = None
+        self._data = None         # canonical buffer (ctx_list[0] replica)
+        self._data_list = None    # one replica per ctx (multi-device DP)
         self._grad = None
         self._ctx_list = None
         self._deferred_init = None
@@ -61,8 +62,9 @@ class Parameter:
         self._grad_req = req
         if self._data is not None:
             if req == "null":
-                self._data.grad_req = "null"
-                self._data._grad = None
+                for d in (self._data_list or [self._data]):
+                    d.grad_req = "null"
+                    d._grad = None
                 self._grad = None
             else:
                 self._init_grad()
@@ -103,12 +105,16 @@ class Parameter:
         desc = _initmod.InitDesc(self.name)
         initializer(desc, data)
         self._data = data
+        # one replica per context: the reference's per-GPU copies
+        # (gluon/parameter.py :: Parameter._init_impl broadcasts to ctx list)
+        self._data_list = [data] + [data.copyto(c) for c in self._ctx_list[1:]]
         self._deferred_init = None
         if self._grad_req != "null":
             self._init_grad()
 
     def _init_grad(self):
-        self._data.attach_grad(grad_req=self._grad_req)
+        for d in (self._data_list or [self._data]):
+            d.attach_grad(grad_req=self._grad_req)
         self._grad = self._data._grad
 
     def _finish_deferred_init(self, in_shape=None):
@@ -153,22 +159,42 @@ class Parameter:
             f"Parameter {self.name!r} has not been initialized. Call "
             ".initialize() first")
 
-    def data(self, ctx=None):  # noqa: ARG002 - one canonical buffer on TPU
+    def _replica(self, ctx):
+        """The replica living on ``ctx`` (reference raises when a parameter
+        was not initialized on the requested context)."""
+        if ctx is None or len(self._data_list) == 1:
+            return self._data_list[0]
+        # inside a jit trace (CachedOp / parallel.TrainStep) inputs are
+        # tracers with no device, so ctx is the current-context fallback —
+        # the canonical slot is the one bound to the traced value
+        from .. import random as _rnd
+        if _rnd.in_trace():
+            return self._data_list[0]
+        for c, d in zip(self._ctx_list, self._data_list):
+            if c == ctx:
+                return d
+        raise MXNetError(
+            f"Parameter {self.name!r} was not initialized on context {ctx}; "
+            f"it lives on {self._ctx_list}")
+
+    def data(self, ctx=None):
         self._check_initialized()
-        return self._data
+        return self._replica(ctx)
 
     def list_data(self):
         self._check_initialized()
-        return [self._data]
+        return list(self._data_list)
 
-    def grad(self, ctx=None):  # noqa: ARG002
+    def grad(self, ctx=None):
         self._check_initialized()
-        if self._data._grad is None:
+        g = self._replica(ctx)._grad
+        if g is None:
             raise MXNetError(f"Parameter {self.name!r} has grad_req='null'")
-        return self._data._grad
+        return g
 
     def list_grad(self):
-        return [self.grad()]
+        self._check_initialized()
+        return [d._grad for d in self._data_list]
 
     def list_ctx(self):
         return list(self._ctx_list or [])
@@ -183,21 +209,35 @@ class Parameter:
             src = data if isinstance(data, NDArray) else nd.array(data)
             self._data = NDArray._from_data(
                 src.astype(self.dtype)._data, ctx=self._ctx_list[0])
+            self._data_list = [self._data] \
+                + [self._data.copyto(c) for c in self._ctx_list[1:]]
             self._deferred_init = None
             if self._grad_req != "null":
                 self._init_grad()
             return
         self._check_initialized()
-        if isinstance(data, NDArray):
-            self._data._set_data(data.astype(self.dtype)._data)
-        else:
-            self._data._set_data(
-                nd.array(data, dtype=self.dtype, ctx=self._ctx_list[0])._data)
+        if not isinstance(data, NDArray):
+            data = nd.array(data, dtype=self.dtype, ctx=self._ctx_list[0])
+        arr = data.astype(self.dtype)._data
+        for d, c in zip(self._data_list, self._ctx_list):
+            import jax
+            d._set_data(jax.device_put(arr, c.jax_device()))
+
+    def _reduce(self):
+        """Sum per-ctx grads into one NDArray (reference Parameter._reduce)."""
+        grads = self.list_grad()
+        out = grads[0].copy()
+        for g in grads[1:]:
+            out += g.as_in_context(out.ctx)
+        return out
 
     def zero_grad(self):
-        if self._data is not None and self._data._grad is not None:
-            self._data._grad._set_data(
-                nd.zeros(self.shape, dtype=self.dtype)._data)
+        if self._data_list is None:
+            return
+        for d in self._data_list:
+            if d._grad is not None:
+                d._grad._set_data(
+                    nd.zeros(self.shape, dtype=self.dtype, ctx=d.ctx)._data)
 
     def reset_ctx(self, ctx):
         if not isinstance(ctx, (list, tuple)):
@@ -205,6 +245,8 @@ class Parameter:
         self._ctx_list = list(ctx)
         if self._data is not None:
             self._data = self._data.as_in_context(ctx[0])
+            self._data_list = [self._data] \
+                + [self._data.copyto(c) for c in ctx[1:]]
             if self._grad_req != "null":
                 self._init_grad()
 
@@ -212,6 +254,8 @@ class Parameter:
         self.dtype = _np.dtype(dtype)
         if self._data is not None:
             self._data = self._data.astype(dtype)
+            self._data_list = [self._data] \
+                + [self._data.copyto(c) for c in self._ctx_list[1:]]
             if self._grad_req != "null":
                 self._init_grad()
 
@@ -236,7 +280,10 @@ class Constant(Parameter):
                          init="__constant__")
 
     def _finish_init(self, init, default_init):  # noqa: ARG002
-        self._data = self.value.copy()
+        ctxs = self._ctx_list or [current_context()]
+        self._data = self.value.copyto(ctxs[0])
+        self._data_list = [self._data] \
+            + [self._data.copyto(c) for c in ctxs[1:]]
         self._deferred_init = None
 
 

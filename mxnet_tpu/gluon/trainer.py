@@ -4,12 +4,17 @@ API parity: Trainer(params, optimizer, optimizer_params, kvstore,
 update_on_kvstore), ``step(batch_size)``, ``allreduce_grads()``, ``update()``,
 ``save_states/load_states``, ``learning_rate`` property.
 
-TPU-native: with kvstore='device'/'local' on one process the gradient
-reduction is an XLA psum over the data-parallel mesh axis (or a no-op on a
-single chip); with 'dist_tpu_sync' the psum spans hosts over ICI/DCN (see
-mxnet_tpu.kvstore).  The optimizer always runs on device (the reference moves
-it to the PS server in dist mode — here the server role does not exist for
-dense training, SURVEY §5.8).
+Multi-device data parallelism (reference flow, src/kvstore/comm.h ::
+CommDevice::ReduceSum): parameters initialized on a ctx *list* carry one
+replica per ctx; ``step`` pushes the per-ctx gradient list to the kvstore,
+which sums it (one XLA add chain — ICI collectives when replicas live on
+different TPU chips), pulls the reduced gradient back into every replica, and
+runs one updater per ctx so replicas stay bit-identical.
+
+``update_on_kvstore=True`` moves the optimizer into the store (the reference
+runs it on the PS server; here the store applies it to its canonical copy and
+``pull`` broadcasts updated weights).  The fused SPMD alternative — whole
+train step jitted over a mesh — is mxnet_tpu.parallel.TrainStep.
 """
 
 from __future__ import annotations
@@ -23,7 +28,7 @@ __all__ = ["Trainer"]
 
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):  # noqa: ARG002
+                 compression_params=None, update_on_kvstore=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -42,7 +47,11 @@ class Trainer:
         self._kvstore_type = kvstore
         self._kvstore = None
         self._kv_initialized = False
-        self._update_on_kvstore = update_on_kvstore
+        self._compression_params = compression_params
+        # reference defaults update_on_kvstore by kvstore type; on TPU the
+        # optimizer is best on device (documented divergence for dist: no
+        # server role exists), so default False unless explicitly requested
+        self._update_on_kvstore = bool(update_on_kvstore)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -56,26 +65,50 @@ class Trainer:
         else:
             self._optimizer = opt.create(optimizer, param_dict=param_dict,
                                          **optimizer_params)
-        self._updaters = [opt.get_updater(self._optimizer)]
+        # one updater per device replica (reference Trainer._updaters): each
+        # holds its own state copies so replicas update identically
+        n_ctx = max((len(p.list_ctx()) or 1 for p in self._params), default=1)
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in range(max(n_ctx, 1))]
+
+    def _row_sparse_params(self):
+        return [p for p in self._params if p.stype == "row_sparse"]
 
     def _init_kvstore(self):
         if self._kv_initialized:
             return
+        # replicas may have been created after __init__ (deferred init):
+        # make sure the updater list covers every ctx
+        n_ctx = max((len(p.list_ctx()) or 1 for p in self._params), default=1)
+        while len(self._updaters) < n_ctx:
+            self._updaters.append(opt.get_updater(self._optimizer))
         kvt = self._kvstore_type
         if kvt is None or kvt is False:
+            if self._update_on_kvstore:
+                raise MXNetError(
+                    "update_on_kvstore=True requires a kvstore "
+                    "(reference raises for this combination)")
             self._kvstore = None
         elif isinstance(kvt, str):
             from .. import kvstore as kvs
-            if kvt in ("local", "device", "nccl") and kvs.num_data_devices() <= 1:
-                self._kvstore = None  # single device: reduction is identity
+            if kvt in ("local", "device", "nccl") and n_ctx <= 1 \
+                    and not self._update_on_kvstore:
+                self._kvstore = None  # single replica: reduction is identity
             else:
                 self._kvstore = kvs.create(kvt)
         else:
             self._kvstore = kvt
         if self._kvstore is not None:
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(
+                    self._compression_params)
             for i, p in enumerate(self._params):
                 if p.grad_req != "null":
                     self._kvstore.init(i, p.data())
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        else:
+            self._update_on_kvstore = False
         self._kv_initialized = True
 
     @property
@@ -94,36 +127,75 @@ class Trainer:
         self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        if not self._update_on_kvstore:
+            self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
         self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "allreduce_grads() is invalid with update_on_kvstore=True "
+                "(reference contract)")
         self._allreduce_grads()
 
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
         for i, p in enumerate(self._params):
-            if p.grad_req != "null":
-                self._kvstore.push(i, p.grad())
-                self._kvstore.pull(i, p.grad())
+            if p.grad_req == "null":
+                continue
+            grads = p.list_grad()
+            self._kvstore.push(i, grads if len(grads) > 1 else grads[0])
+            if self._update_on_kvstore:
+                # store ran the optimizer; pull updated weights to replicas
+                datas = p.list_data()
+                self._kvstore.pull(i, datas if len(datas) > 1 else datas[0])
+            else:
+                self._kvstore.pull(i, grads if len(grads) > 1 else grads[0])
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "update() is invalid with update_on_kvstore=True "
+                "(reference contract)")
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):  # noqa: ARG002
-        updater = self._updaters[0]
+        optzr = self._optimizer
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or p._data is None:
                 continue
-            updater(i, p.grad(), p.data())
+            # replicas must see the SAME step count t (Adam bias correction,
+            # lr schedules): snapshot the shared optimizer's counters before
+            # the first replica and restore for each subsequent one, so one
+            # logical step advances t exactly once
+            snap_counts = dict(optzr._index_update_count)
+            snap_num = optzr.num_update
+            for j, (upd, w, g) in enumerate(
+                    zip(self._updaters, p.list_data(), p.list_grad())):
+                if j > 0:
+                    optzr._index_update_count = dict(snap_counts)
+                    optzr.num_update = snap_num
+                upd(i, g, w)
 
     def save_states(self, fname):
+        """With update_on_kvstore the optimizer state lives in the store
+        (reference delegates to kvstore.save_optimizer_states)."""
+        self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+            return
         with open(fname, "wb") as f:
             f.write(self._updaters[0].get_states())
 
     def load_states(self, fname):
+        self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            return
         with open(fname, "rb") as f:
-            self._updaters[0].set_states(f.read())
+            data = f.read()
+        for u in self._updaters:
+            u.set_states(data)

@@ -49,9 +49,11 @@ class KVStoreLocal(KVStoreBase):
             return values[0]
         if isinstance(values[0], sp.RowSparseNDArray):
             return self._reduce_rowsparse(values)
+        # per-device replicas are committed to their devices; stage onto the
+        # first value's device then sum — one XLA add chain (CommDevice role)
         out = values[0]
         for v in values[1:]:
-            out = out + v
+            out = out + v.as_in_context(out.ctx)
         return out
 
     @staticmethod
@@ -113,13 +115,15 @@ class KVStoreLocal(KVStoreBase):
         if key not in self._store:
             raise MXNetError(f"key {key!r} not initialized")
         stored = self._store[key]
+        if isinstance(stored, sp.BaseSparseNDArray):
+            stored = stored.tostype("default")
         outs = out if _is_list(out) else [out]
+        import jax
         for o in outs:
-            if isinstance(stored, sp.BaseSparseNDArray):
-                dense = stored.tostype("default")
-                o._set_data(dense._data)
-            else:
-                o._set_data(stored._data)
+            arr = stored._data
+            if o.ctx != stored.ctx:
+                arr = jax.device_put(arr, o.ctx.jax_device())
+            o._set_data(arr)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):  # noqa: ARG002
         if row_ids is None:

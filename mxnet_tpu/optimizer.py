@@ -205,7 +205,10 @@ class Adam(Optimizer):
         t = self._index_update_count[index]
         kw = self._common_kwargs(index)
         # bias correction folded into lr (reference adam_update does this)
-        kw["lr"] *= _np.sqrt(1. - self.beta2 ** t) / (1. - self.beta1 ** t)
+        # operator-only math: t may be a traced scalar inside the fused
+        # SPMD train step (mxnet_tpu.parallel.TrainStep), where np ufuncs
+        # would force concretization
+        kw["lr"] *= (1. - self.beta2 ** t) ** 0.5 / (1. - self.beta1 ** t)
         mean, var = state
         nd.adam_update(weight, grad, mean, var, out=[weight, mean, var],
                        beta1=self.beta1, beta2=self.beta2,

@@ -118,6 +118,13 @@ class trace_key_scope:
         return False
 
 
+def in_trace():
+    """True while a trace_key_scope is active (CachedOp / TrainStep tracing).
+    Used by components that must behave ctx-agnostically under tracers
+    (e.g. Parameter replica selection)."""
+    return bool(getattr(_state, "trace_keys", None))
+
+
 def fork_key(ctx=None, num=2):
     import jax
     k = get_key(ctx)

@@ -10,11 +10,19 @@ fixture below.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The sandbox presets JAX_PLATFORMS=axon (the real chip); the suite runs on
+# the virtual 8-CPU platform per SURVEY §4 unless explicitly pointed at TPU
+# with MXNET_TEST_DEVICE=tpu.  A pytest plugin imports jax before this
+# conftest runs, so env vars alone are too late — go through jax.config
+# (safe: backends have not been initialized yet at collection time).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = \
         (flags + " --xla_force_host_platform_device_count=8").strip()
+if os.environ.get("MXNET_TEST_DEVICE", "cpu") != "tpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
