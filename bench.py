@@ -1,0 +1,122 @@
+"""Driver benchmark: flagship BERT-base training-step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The measured config mirrors BASELINE's north star (BERT-base pretrain):
+batch x seq MLM step — forward + backward + Adam, fused into a single XLA
+program by parallel.TrainStep.  vs_baseline is measured MFU / 0.45 (the
+BASELINE target: >= 45% MFU => vs_baseline >= 1.0).
+
+Env knobs:
+  MXNET_BENCH_MODEL   bert_12_768_12 (default) | bert_6_512_8 | bert_3_128_2
+  MXNET_BENCH_BATCH   default 8
+  MXNET_BENCH_SEQLEN  default 128
+  MXNET_BENCH_DTYPE   bfloat16 (default) | float32
+  MXNET_BENCH_STEPS   timed steps, default 8
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _peak_flops(dtype):
+    """Per-chip peak for MFU accounting. v5e (axon 'TPU v5 lite'): 394
+    TFLOP/s bf16; fp32 ~1/4 of bf16 on the MXU.  CPU fallback: nominal."""
+    import jax
+    d = jax.devices()[0]
+    if d.platform == "cpu":
+        return 5e11
+    bf16_peak = 394e12  # TPU v5e
+    if "v4" in str(getattr(d, "device_kind", "")).lower():
+        bf16_peak = 275e12
+    return bf16_peak if dtype == "bfloat16" else bf16_peak / 4
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.gluon.model_zoo import bert
+
+    name = os.environ.get("MXNET_BENCH_MODEL", "bert_12_768_12")
+    batch = int(os.environ.get("MXNET_BENCH_BATCH", "128"))
+    seq_len = int(os.environ.get("MXNET_BENCH_SEQLEN", "128"))
+    dtype = os.environ.get("MXNET_BENCH_DTYPE", "bfloat16")
+    steps = int(os.environ.get("MXNET_BENCH_STEPS", "8"))
+    vocab = 30522
+
+    if dtype == "bfloat16":
+        # bf16 compute with fp32 master weights (multi_precision)
+        import jax
+        jax.config.update("jax_default_matmul_precision", "default")
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    model = bert.bert_model(name, vocab_size=vocab, max_length=seq_len,
+                            dropout=0.0)
+    model.initialize(mx.initializer.Normal(0.02))
+    if dtype == "bfloat16":
+        import ml_dtypes
+        model.cast(ml_dtypes.bfloat16)
+
+    def loss_fn(out, labels):
+        _, _, logits = out
+        return mx.nd.softmax_cross_entropy(
+            logits.reshape((-1, logits.shape[-1])).astype("float32"),
+            labels.reshape((-1,))) / labels.size
+
+    mesh = parallel.make_mesh()  # all local devices (1 on the bench chip)
+    opt = mx.optimizer.Adam(learning_rate=1e-4,
+                            multi_precision=(dtype == "bfloat16"))
+    step = parallel.TrainStep(model, loss_fn, opt, mesh=mesh)
+
+    tokens = nd.array(np.random.randint(0, vocab, (batch, seq_len)),
+                      dtype="int32")
+    labels = nd.array(np.random.randint(0, vocab, (batch, seq_len)),
+                      dtype="int32")
+
+    def sync():
+        # wait for the full step (params updated), not just the loss value
+        import jax
+        jax.block_until_ready(
+            [p._data._data for p in model.collect_params().values()])
+        loss.wait_to_read()
+
+    # warmup (compile)
+    for _ in range(2):
+        loss = step(tokens, labels)
+    sync()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(tokens, labels)
+    sync()
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch * steps / dt
+
+    # MFU: flops/token ~= 6*N (fwd+bwd matmuls) + attention 12*l*C*S
+    cfg = bert._BERT_CONFIGS[name]
+    n_layers, units, hidden, _heads = cfg
+    n_params = sum(int(np.prod(p.shape))
+                   for p in model.collect_params().values()
+                   if p.shape is not None)
+    flops_per_token = 6 * n_params + 12 * n_layers * units * seq_len
+    tokens_per_sec = samples_per_sec * seq_len
+    mfu = tokens_per_sec * flops_per_token / _peak_flops(dtype)
+
+    print(json.dumps({
+        "metric": f"{name}_train_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 3),
+        "unit": "samples/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {"mfu": round(mfu, 4), "dtype": dtype, "batch": batch,
+                  "seq_len": seq_len, "step_ms": round(1000 * dt / steps, 2),
+                  "loss": float(np.asarray(loss.asnumpy(), np.float64))},
+    }))
+
+
+if __name__ == "__main__":
+    main()

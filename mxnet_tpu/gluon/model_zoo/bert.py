@@ -1,0 +1,160 @@
+"""BERT-style transformer encoder — the flagship perf model (BASELINE:
+BERT-base pretrain ≥45% MFU north star).
+
+Reference anchors: the attention fast path mirrors
+src/operator/contrib/transformer.cc (`_contrib_interleaved_matmul_selfatt_qk`
+/ `_valatt`, `_contrib_div_sqrt_dim`) which GluonNLP's BERT uses on GPU; the
+block structure follows GluonNLP bert.py (external repo — the reference keeps
+no transformer model in-tree, SURVEY §5.7).
+
+TPU-native notes:
+ - time-major (L, B, C) through the encoder cells so the fused interleaved
+   attention ops keep the reference layout contract;
+ - ``apply_tp_shardings(model, axis='tp')`` annotates the megatron split
+   (qkv/ffn-in column-parallel, proj/ffn-out row-parallel) via
+   ``Parameter.sharding`` hints consumed by parallel.TrainStep — GSPMD then
+   partitions the matmuls over the mesh's 'tp' axis;
+ - flash attention (pallas) plugs in underneath the same ops when available
+   (ops/contrib.py).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..block import HybridBlock
+from ..nn import Dense, Dropout, Embedding, LayerNorm
+
+__all__ = ["BERTEncoderCell", "BERTEncoder", "BERTModel", "bert_model",
+           "apply_tp_shardings"]
+
+
+class BERTEncoderCell(HybridBlock):
+    """One post-norm transformer encoder block over the fused attention ops."""
+
+    def __init__(self, units=768, hidden_size=3072, num_heads=12,
+                 dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._num_heads = num_heads
+        with self.name_scope():
+            self.attn_qkv = Dense(3 * units, flatten=False, in_units=units,
+                                  prefix="attn_qkv_")
+            self.attn_proj = Dense(units, flatten=False, in_units=units,
+                                   prefix="attn_proj_")
+            self.ffn_1 = Dense(hidden_size, flatten=False, in_units=units,
+                               prefix="ffn1_")
+            self.ffn_2 = Dense(units, flatten=False, in_units=hidden_size,
+                               prefix="ffn2_")
+            self.layer_norm_att = LayerNorm(in_channels=units, prefix="ln1_")
+            self.layer_norm_ffn = LayerNorm(in_channels=units, prefix="ln2_")
+            self.drop = Dropout(dropout)
+
+    def hybrid_forward(self, F, x):
+        # x: (L, B, C) time-major (reference transformer.cc layout contract)
+        qkv = self.attn_qkv(x)
+        att = F.contrib.interleaved_matmul_selfatt_qk(
+            qkv, heads=self._num_heads)
+        att = F.softmax(att, axis=-1)
+        ctx_vec = F.contrib.interleaved_matmul_selfatt_valatt(
+            qkv, att, heads=self._num_heads)
+        out = self.layer_norm_att(x + self.drop(self.attn_proj(ctx_vec)))
+        h = self.ffn_2(F.gelu(self.ffn_1(out)))
+        return self.layer_norm_ffn(out + self.drop(h))
+
+
+class BERTEncoder(HybridBlock):
+    def __init__(self, num_layers=12, units=768, hidden_size=3072,
+                 num_heads=12, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.cells = []
+        with self.name_scope():
+            for i in range(num_layers):
+                cell = BERTEncoderCell(units, hidden_size, num_heads, dropout,
+                                       prefix=f"layer{i}_")
+                self.register_child(cell, f"layer{i}")
+                self.cells.append(cell)
+
+    def hybrid_forward(self, F, x):
+        for cell in self.cells:
+            x = cell(x)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """Embeddings + encoder + pooler + MLM decoder.
+
+    ``forward(tokens)`` (batch-major (B, L) int tokens) returns
+    ``(sequence_output (B, L, C), pooled (B, C), mlm_logits (B, L, V))``.
+    """
+
+    def __init__(self, vocab_size=30522, num_layers=12, units=768,
+                 hidden_size=3072, num_heads=12, max_length=512,
+                 dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._max_length = max_length
+        with self.name_scope():
+            self.word_embed = Embedding(vocab_size, units, prefix="word_")
+            self.position_weight = self.params.get(
+                "position_weight", shape=(max_length, units), init=None)
+            self.embed_norm = LayerNorm(in_channels=units, prefix="embln_")
+            self.embed_drop = Dropout(dropout)
+            self.encoder = BERTEncoder(num_layers, units, hidden_size,
+                                       num_heads, dropout, prefix="enc_")
+            self.pooler = Dense(units, flatten=False, in_units=units,
+                                activation="tanh", prefix="pooler_")
+            self.decoder = Dense(vocab_size, flatten=False, in_units=units,
+                                 prefix="decoder_")
+
+    def hybrid_forward(self, F, tokens, position_weight):
+        seq_len = tokens.shape[1]
+        x = self.word_embed(tokens)
+        pos = F.slice_axis(position_weight, axis=0, begin=0, end=seq_len)
+        x = x + F.expand_dims(pos, axis=0)
+        x = self.embed_drop(self.embed_norm(x))
+        x = F.transpose(x, axes=(1, 0, 2))       # (B,L,C) -> (L,B,C)
+        x = self.encoder(x)
+        x = F.transpose(x, axes=(1, 0, 2))       # back to (B,L,C)
+        first = F.reshape(F.slice_axis(x, axis=1, begin=0, end=1),
+                          shape=(0, -1))
+        pooled = self.pooler(first)
+        logits = self.decoder(x)
+        return x, pooled, logits
+
+
+_BERT_CONFIGS = {
+    # name: (num_layers, units, hidden, heads)
+    "bert_12_768_12": (12, 768, 3072, 12),
+    "bert_24_1024_16": (24, 1024, 4096, 16),
+    "bert_6_512_8": (6, 512, 2048, 8),
+    "bert_3_128_2": (3, 128, 512, 2),   # tiny (tests / dryrun)
+}
+
+
+def bert_model(name="bert_12_768_12", vocab_size=30522, max_length=512,
+               dropout=0.1, **kwargs):
+    if name not in _BERT_CONFIGS:
+        raise ValueError(f"unknown BERT config {name!r}; "
+                         f"known {sorted(_BERT_CONFIGS)}")
+    L, U, H, A = _BERT_CONFIGS[name]
+    return BERTModel(vocab_size=vocab_size, num_layers=L, units=U,
+                     hidden_size=H, num_heads=A, max_length=max_length,
+                     dropout=dropout, **kwargs)
+
+
+def apply_tp_shardings(model, axis="tp"):
+    """Annotate megatron-style tensor-parallel shardings on a BERTModel.
+
+    Column-parallel (shard the output features): attn qkv, ffn_1.
+    Row-parallel (shard the input features): attn proj, ffn_2.
+    Dense weights are (out_features, in_features).
+    """
+    for name, p in model.collect_params().items():
+        if p.shape is None or len(p.shape) != 2:
+            continue
+        if "attn_qkv_weight" in name or "ffn1_weight" in name:
+            p.sharding = (axis, None)
+        elif "attn_proj_weight" in name or "ffn2_weight" in name:
+            p.sharding = (None, axis)
+    return model

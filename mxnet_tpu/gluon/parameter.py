@@ -194,6 +194,8 @@ class Parameter:
 
     def list_grad(self):
         self._check_initialized()
+        if self._data._grad is None:
+            raise MXNetError(f"Parameter {self.name!r} has grad_req='null'")
         return [d._grad for d in self._data_list]
 
     def list_ctx(self):

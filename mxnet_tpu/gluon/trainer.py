@@ -168,15 +168,18 @@ class Trainer:
             if p.grad_req == "null" or p._data is None:
                 continue
             # replicas must see the SAME step count t (Adam bias correction,
-            # lr schedules): snapshot the shared optimizer's counters before
-            # the first replica and restore for each subsequent one, so one
-            # logical step advances t exactly once
-            snap_counts = dict(optzr._index_update_count)
+            # lr schedules): snapshot the shared optimizer's counters for
+            # this index before the first replica and restore for each
+            # subsequent one, so one logical step advances t exactly once
+            snap_count = optzr._index_update_count.get(i)
             snap_num = optzr.num_update
             for j, (upd, w, g) in enumerate(
                     zip(self._updaters, p.list_data(), p.list_grad())):
                 if j > 0:
-                    optzr._index_update_count = dict(snap_counts)
+                    if snap_count is None:
+                        optzr._index_update_count.pop(i, None)
+                    else:
+                        optzr._index_update_count[i] = snap_count
                     optzr.num_update = snap_num
                 upd(i, g, w)
 

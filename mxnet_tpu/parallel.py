@@ -155,14 +155,18 @@ def allreduce(values, mesh=None, op="sum"):
     jax = _jax()
     if op not in ("sum", "mean"):
         raise MXNetError(f"allreduce op must be 'sum' or 'mean', got {op!r}")
-    if mesh is None:
-        mesh = _current_mesh or make_mesh(
-            devices=[v._data.device for v in values]
-            if all(isinstance(v, NDArray) for v in values) else None)
     arrays = [v._data if isinstance(v, NDArray) else v for v in values]
     n = len(arrays)
     if n == 1:
         return list(values)
+    if mesh is None or mesh.size != n:
+        # reduce over exactly the values' devices: build a local sub-mesh
+        # (no global-mesh mutation — a partial reduction must not re-point
+        # current_mesh(), and the psum axis must span exactly n shards)
+        devs = [getattr(a, "device", None) for a in arrays]
+        if any(d is None for d in devs) or len(set(devs)) != n:
+            devs = jax.devices()[:n]
+        mesh = DeviceMesh(devices=devs, axis_names=("dp",))
     axis = mesh.axis_names[0]
     sharding = mesh.sharded(axis)
     shape = tuple(arrays[0].shape)
@@ -207,13 +211,57 @@ def allreduce(values, mesh=None, op="sum"):
 
 
 def allgather(values, mesh=None):
-    """Concatenate per-device shards on every device (all_gather)."""
+    """Concatenate per-device shards along axis 0 on every device
+    (all_gather over the mesh axis — same zero-copy assembly as allreduce)."""
     jax = _jax()
     arrays = [v._data if isinstance(v, NDArray) else v for v in values]
-    gathered = jax.numpy.concatenate([jax.numpy.asarray(_np.asarray(a))
-                                      for a in arrays], axis=0)
-    return [NDArray._from_data(jax.device_put(gathered, a.device))
-            for a in arrays]
+    n = len(arrays)
+    if n == 1:
+        return list(values)
+    if mesh is None or mesh.size != n:
+        devs = [getattr(a, "device", None) for a in arrays]
+        if any(d is None for d in devs) or len(set(devs)) != n:
+            devs = jax.devices()[:n]
+        mesh = DeviceMesh(devices=devs, axis_names=("dp",))
+    axis = mesh.axis_names[0]
+    shard_shape = tuple(arrays[0].shape)
+    sharding = mesh.sharded(axis)
+
+    in_devices = [getattr(a, "device", None) for a in arrays]
+    if in_devices == mesh.devices:
+        stacked = jax.make_array_from_single_device_arrays(
+            (n,) + shard_shape, sharding, [a[None] for a in arrays])
+    else:
+        stacked = jax.device_put(
+            jax.numpy.stack([_np.asarray(a) for a in arrays]), sharding)
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    @jax.jit
+    def _gather(x):
+        def f(xs):
+            g = jax.lax.all_gather(xs[0], axis)  # (n,)+shard_shape
+            return g[None]
+        return shard_map(f, mesh=mesh.mesh,
+                         in_specs=mesh.spec(axis),
+                         out_specs=mesh.spec(axis))(x)
+
+    gathered = _gather(stacked)
+    out_shape = (n * shard_shape[0],) + shard_shape[1:] if shard_shape \
+        else (n,)
+    per_shard = {s.device: s.data for s in gathered.addressable_shards}
+    out = []
+    for a in arrays:
+        local = per_shard.get(getattr(a, "device", None))
+        if local is None:
+            local = jax.device_put(
+                _np.asarray(gathered.addressable_shards[0].data),
+                getattr(a, "device", None))
+        out.append(NDArray._from_data(local.reshape(out_shape)))
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -324,15 +372,23 @@ class TrainStep:
         n_train = len(trainable)
 
         def raw(key, t, lr_vec, rescale, param_vals, state_vals, d, l):
+            import jax.numpy as jnp
             saved_p = [(p._data._slot, p._data._slot.value) for p in params]
-            saved_s = [(s._slot, s._slot.value) for s in state_nds]
+            saved_g = [(p._data._grad._slot, p._data._grad._slot.value)
+                       for p in trainable]
             saved_opt = (optzr._update_count, optzr._index_update_count,
                          optzr._get_lr, optzr.rescale_grad)
+            saved_s = [(s._slot, s._slot.value) for s in state_nds]
             try:
                 for p, v in zip(params, param_vals):
                     p._data._slot.value = v
                 for s, v in zip(state_nds, state_vals):
                     s._slot.value = v
+                # zero grad buffers in-trace: params the loss does not reach
+                # keep a zero gradient (reference tolerates stale grads)
+                for p in trainable:
+                    p._data._grad._slot.value = jnp.zeros(
+                        p.shape, p._data._grad.dtype)
                 optzr._update_count = lambda idx: None
                 optzr._index_update_count = _TracedCount(t)
                 optzr._get_lr = lambda idx: lr_vec[idx]
@@ -345,16 +401,18 @@ class TrainStep:
                     loss = loss_fn(out, l_nd)
                     if loss.shape:
                         loss = loss.mean()
-                grads = autograd.grad(
-                    [loss], [p._data for p in trainable], retain_graph=False)
-                for i, (p, g) in enumerate(zip(trainable, grads)):
-                    optzr.update_multi_precision(i, p._data, g,
+                autograd.backward([loss])
+                for i, p in enumerate(trainable):
+                    optzr.update_multi_precision(i, p._data,
+                                                 p._data._grad,
                                                  self._states[i])
                 new_p = tuple(p._data._slot.value for p in params)
                 new_s = tuple(s._slot.value for s in state_nds)
                 return new_p, new_s, loss._data
             finally:
                 for slot, old in saved_p:
+                    slot.value = old
+                for slot, old in saved_g:
                     slot.value = old
                 for slot, old in saved_s:
                     slot.value = old
@@ -400,7 +458,9 @@ class TrainStep:
         lr_vec = _np.array([self.optimizer._get_lr(i)
                             for i in range(len(self._trainable))], _np.float32)
         rescale = _np.float32(self.optimizer.rescale_grad)
-        key = jax.random.fold_in(jax.random.PRNGKey(0), self._step_count)
+        # per-step dropout key from the seeded stateful stream (mx.random.seed)
+        from . import random as _rnd
+        key = _rnd.get_key()
 
         batch_sh = self.mesh.sharded(self.mesh.axis_names[0])
         d = jax.device_put(data._data, batch_sh)

@@ -245,3 +245,27 @@ def test_trainer_states_roundtrip(tmp_path, ctxs):
         t2 = gluon.Trainer(net.collect_params(), "sgd", kvstore=None,
                            update_on_kvstore=True)
         t2._init_kvstore()
+
+
+def test_allgather_eager(ctxs):
+    from mxnet_tpu import parallel
+    vals = [nd.array(np.full((2,), float(i), "float32"), ctx=c)
+            for i, c in enumerate(ctxs[:4])]
+    out = parallel.allgather(vals)
+    expect = np.repeat(np.arange(4, dtype="float32"), 2)
+    assert len(out) == 4
+    for o in out:
+        assert_almost_equal(o.asnumpy(), expect)
+
+
+def test_allreduce_subset_of_mesh(ctxs):
+    """code-review r2: allreduce over fewer devices than the current mesh
+    must not crash nor clobber the global mesh."""
+    from mxnet_tpu import parallel
+    parallel.make_mesh()  # global 8-device mesh
+    vals = [nd.array(np.full((2,), float(i + 1), "float32"), ctx=c)
+            for i, c in enumerate(ctxs[:4])]
+    out = parallel.allreduce(vals)
+    for o in out:
+        assert_almost_equal(o.asnumpy(), np.full((2,), 10.0, "float32"))
+    assert parallel.current_mesh().size == N_DEV  # untouched
