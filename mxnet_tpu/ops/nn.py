@@ -682,3 +682,34 @@ def _rnn_infer(shapes, attrs):
 
 
 _get_op("RNN").infer_args = _rnn_infer
+
+
+# -- declared input names (reference nnvm FListInputNames): symbol
+# composition auto-creates "<name>_<input>" variables for inputs not passed
+# (src/operator/nn/fully_connected.cc lists data/weight/bias etc.) ---------
+
+def _wire_inputs(opname, names, aux=(), omit=None):
+    op = _get_op(opname)
+    op.input_names = tuple(names)
+    op.aux_names = frozenset(aux)
+    op.omit_inputs = omit
+
+
+_wire_inputs("FullyConnected", ("data", "weight", "bias"),
+             omit=lambda attrs: {"bias"} if attrs.get("no_bias") else set())
+_wire_inputs("Convolution", ("data", "weight", "bias"),
+             omit=lambda attrs: {"bias"} if attrs.get("no_bias") else set())
+_wire_inputs("Deconvolution", ("data", "weight", "bias"),
+             omit=lambda attrs: {"bias"}
+             if attrs.get("no_bias", True) else set())
+_wire_inputs("BatchNorm",
+             ("data", "gamma", "beta", "moving_mean", "moving_var"),
+             aux=("moving_mean", "moving_var"))
+_wire_inputs("LayerNorm", ("data", "gamma", "beta"))
+_wire_inputs("InstanceNorm", ("data", "gamma", "beta"))
+_wire_inputs("GroupNorm", ("data", "gamma", "beta"))
+_wire_inputs("Embedding", ("data", "weight"))
+_wire_inputs("RNN", ("data", "parameters", "state", "state_cell"),
+             omit=lambda attrs: set()
+             if attrs.get("mode", "lstm") == "lstm" else {"state_cell"})
+_wire_inputs("SoftmaxOutput", ("data", "label"))

@@ -62,7 +62,8 @@ class Op:
 
     __slots__ = ("name", "fn", "num_outputs", "differentiable",
                  "mutate_inputs", "wrap_key", "wrap_train", "doc", "jit",
-                 "visible_outputs", "dynamic_attrs", "infer_args")
+                 "visible_outputs", "dynamic_attrs", "infer_args",
+                 "input_names", "aux_names", "omit_inputs")
 
     def __init__(self, name, fn, num_outputs=1, differentiable=True,
                  mutate_inputs=(), wrap_key=None, wrap_train=None, jit=True,
@@ -87,6 +88,14 @@ class Op:
         # shapes from known ones (the FInferShape backward-propagation role,
         # used by Symbol.infer_shape / simple_bind)
         self.infer_args = None
+        # input_names: declared positional inputs (reference nnvm
+        # FListInputNames) — symbol composition auto-creates variables
+        # "<name>_<input>" for the ones not passed, aux_names marking
+        # auxiliary states (BatchNorm moving stats).  omit_inputs(attrs)
+        # returns input names absent under these attrs (e.g. no_bias).
+        self.input_names = None
+        self.aux_names = frozenset()
+        self.omit_inputs = None
 
     def __repr__(self):
         return f"<Op {self.name}>"

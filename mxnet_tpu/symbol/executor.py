@@ -33,10 +33,23 @@ class Executor:
         missing = [a for a in arg_names if a not in self.arg_dict]
         if missing:
             raise MXNetError(f"bind missing arguments: {missing}")
-        self._run, self._leaves = symbol._build_fn()
+        # one build per train mode (wrap_train flags differ); training mode
+        # also threads out mutated aux states (BN moving stats writeback)
+        self._builds = {}
+        self._leaves = None
         self.outputs = []
         self._vjp = None
-        self._jit = None
+
+    def _get_build(self, is_train):
+        entry = self._builds.get(is_train)
+        if entry is None:
+            import jax
+            run, leaves, mut_specs = self._symbol._build_fn(
+                train_mode=is_train, collect_mutations=is_train)
+            entry = (jax.jit(run), leaves, mut_specs)
+            self._builds[is_train] = entry
+        self._leaves = entry[1]
+        return entry
 
     def _leaf_arrays(self, extra=None):
         arrays = []
@@ -56,20 +69,29 @@ class Executor:
 
     def forward(self, is_train=False, **kwargs):
         import jax
-        from .. import autograd
+        from .. import autograd, random as _rnd
+        jit_run, leaves, mut_specs = self._get_build(is_train)
         for k, v in kwargs.items():
             if k in self.arg_dict:
                 self.arg_dict[k]._set_data(
                     v._data if isinstance(v, NDArray) else v)
         arrays = self._leaf_arrays()
-        if self._jit is None:
-            self._jit = jax.jit(self._run)
+        key = _rnd.get_key(self._ctx)
         with autograd._scope(training=is_train):
             if is_train and self.grad_req != "null":
-                out, self._vjp = jax.vjp(self._jit, *arrays)
+                f = lambda *a: jit_run(key, *a)  # noqa: E731
+                out, self._vjp = jax.vjp(f, *arrays)
             else:
-                out = self._jit(*arrays)
+                out = jit_run(key, *arrays)
                 self._vjp = None
+        if is_train:
+            out, muts = out
+            # FMutateInputs writeback: updated aux states land in aux_dict
+            for (leaf_name, _, _), val in zip(mut_specs, muts):
+                dst = self.aux_dict.get(leaf_name,
+                                        self.arg_dict.get(leaf_name))
+                if dst is not None:
+                    dst._set_data(val)
         self._out_was_tuple = isinstance(out, tuple)
         outs = out if self._out_was_tuple else (out,)
         self.outputs = [NDArray._from_data(o, ctx=self._ctx) for o in outs]
@@ -85,8 +107,16 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             cts = tuple(g._data for g in out_grads)
-        ct_arg = cts if self._out_was_tuple else cts[0]
-        grads = self._vjp(ct_arg)
+        ct_main = cts if self._out_was_tuple else cts[0]
+        # training forward returns (main, mutated_aux): zero cotangents for
+        # the aux updates (they are state writes, not differentiated outputs)
+        _, _, mut_specs = self._get_build(True)
+        mut_cts = tuple(
+            jnp.zeros(self.aux_dict[n].shape, self.aux_dict[n].dtype)
+            if n in self.aux_dict else
+            jnp.zeros(self.arg_dict[n].shape, self.arg_dict[n].dtype)
+            for (n, _, _) in mut_specs)
+        grads = self._vjp((ct_main, mut_cts))
         for s, g in zip(self._leaves, grads):
             dst = self.grad_dict.get(s._name)
             if dst is None:

@@ -7,6 +7,7 @@ from __future__ import annotations
 import sys
 import types
 
+from ..base import MXNetError
 from ..ops import registry as _reg
 from .symbol import Symbol, _make
 
@@ -22,8 +23,46 @@ def _auto_name(opname):
 
 def _make_sym_func(op):
     def fn(*args, name=None, attr=None, **attrs):
+        from .symbol import var
         inputs = [a for a in args if isinstance(a, Symbol)]
-        s = Symbol(op, inputs, attrs, name=name or _auto_name(op.name),
+        sym_name = name or _auto_name(op.name)
+        if op.input_names is not None:
+            # reference nnvm composition: keyword Symbols fill their named
+            # slot; missing inputs become auto-created variables
+            # "<name>_<input>" (aux slots flagged, excluded from arguments)
+            omit = op.omit_inputs(attrs) if op.omit_inputs else set()
+            wanted = [n for n in op.input_names if n not in omit]
+            by_name = {}
+            for n in wanted:
+                if n in attrs and isinstance(attrs[n], Symbol):
+                    by_name[n] = attrs.pop(n)
+            pos = list(inputs)
+            full = []
+            for n in wanted:
+                if n in by_name:
+                    v = by_name[n]
+                elif pos:
+                    v = pos.pop(0)
+                else:
+                    v = var(f"{sym_name}_{n}")
+                # aux-ness follows the op's declared slot (reference
+                # FListAuxiliaryStates), however the input was supplied
+                if n in op.aux_names and v._op is None:
+                    v._attrs["__aux__"] = True
+                full.append(v)
+            if pos:
+                raise MXNetError(
+                    f"operator {op.name!r} takes inputs {wanted} "
+                    f"(attrs {sorted(omit)} omitted); {len(pos)} extra "
+                    f"positional symbol(s) could not be placed")
+            inputs = full
+        leftover = [k for k, v in attrs.items() if isinstance(v, Symbol)]
+        if leftover:
+            raise MXNetError(
+                f"operator {op.name!r}: symbol(s) passed for "
+                f"non-input keyword(s) {leftover} (reference nnvm "
+                f"composition rejects unplaceable inputs)")
+        s = Symbol(op, inputs, attrs, name=sym_name,
                    num_outputs=op.num_outputs if op.num_outputs > 0 else 1)
         if attr:
             s._attrs.update(attr)

@@ -146,16 +146,49 @@ class Symbol:
         return _make("transpose", [self], {"axes": axes})
 
     # -- evaluation ----------------------------------------------------------
+    def _visible_head(self, main):
+        """Truncate a multi-output head to its visible outputs (reference
+        'visible outputs': BatchNorm exposes 1 of its 3)."""
+        if (self._op is not None
+                and self._op not in ("group", "output_slice")
+                and isinstance(main, tuple)
+                and self._op.visible_outputs is not None):
+            vis = main[:self._op.visible_outputs]
+            return vis[0] if len(vis) == 1 else vis
+        return main
+
     def _leaf_syms(self):
         return [s for s in self._walk() if s._op is None]
 
-    def _build_fn(self):
-        """Lower the DAG to a python function over leaf arrays (traceable)."""
+    def _build_fn(self, train_mode=False, collect_mutations=False):
+        """Lower the DAG to ``run(key, *leaf_arrays)`` (traceable).
+
+        ``train_mode`` feeds each op's wrap_train flag (Dropout/BatchNorm
+        behavior); RNG-consuming ops get per-node splits of ``key``.  With
+        ``collect_mutations`` the run also returns the updated values of
+        mutated leaf inputs (FMutateInputs — BatchNorm moving stats), as
+        ``(main_out, (mut_val, ...))``; ``mut_specs`` names them.
+        """
         leaves = self._leaf_syms()
         leaf_pos = {id(s): i for i, s in enumerate(leaves)}
+        order = self._walk()
+        op_nodes = [s for s in order
+                    if s._op is not None
+                    and s._op not in ("group", "output_slice")]
+        rng_idx = {id(s): i for i, s in enumerate(
+            [s for s in op_nodes if s._op.wrap_key is not None])}
+        mut_specs = []   # (leaf_name, node, out_idx)
+        if collect_mutations:
+            for s in op_nodes:
+                for oi, ii in s._op.mutate_inputs:
+                    tgt = s._inputs[ii]
+                    if tgt._op is None:
+                        mut_specs.append((tgt._name, s, oi))
 
-        def run(*arrays):
+        def run(key, *arrays):
+            import jax
             cache = {}
+            subkeys = jax.random.split(key, max(len(rng_idx), 1))
 
             def ev(s):
                 if id(s) in cache:
@@ -174,23 +207,40 @@ class Symbol:
                         # a multi-output producer feeds its first output
                         # unless explicitly sliced (reference nnvm entries)
                         ins.append(x[0] if isinstance(x, (tuple, list)) else x)
-                    v = _reg.invoke_arrays(s._op, ins, s._attrs)
+                    attrs = s._attrs
+                    op = s._op
+                    if op.wrap_train is not None or op.wrap_key is not None:
+                        attrs = dict(attrs)
+                        if op.wrap_train is not None:
+                            attrs[op.wrap_train] = train_mode
+                        if op.wrap_key is not None:
+                            attrs[op.wrap_key] = subkeys[rng_idx[id(s)]]
+                    v = _reg.invoke_arrays(op, ins, attrs)
                     if isinstance(v, list):
                         v = tuple(v)
                 cache[id(s)] = v
                 return v
-            return ev(self)
-        return run, leaves
+
+            main = ev(self)
+            main = self._visible_head(main)
+            if not collect_mutations:
+                return main
+            muts = tuple(_as_tuple(cache[id(node)])[oi]
+                         for (_, node, oi) in mut_specs)
+            return main, muts
+
+        return run, leaves, mut_specs
 
     def eval(self, ctx=None, **kwargs):
-        run, leaves = self._build_fn()
+        from .. import random as _rnd
+        run, leaves, _ = self._build_fn()
         arrays = []
         for s in leaves:
             if s._name not in kwargs:
                 raise MXNetError(f"eval missing argument {s._name!r}")
             v = kwargs[s._name]
             arrays.append(v._data if isinstance(v, NDArray) else v)
-        out = run(*arrays)
+        out = run(_rnd.get_key(), *arrays)
         outs = _as_tuple(out)
         return [NDArray._from_data(o, ctx=ctx) for o in outs]
 
@@ -258,7 +308,13 @@ class Symbol:
         name2shape = {s._name: shape_of.get(id(s))
                       for s in order if s._op is None}
         head = shape_of.get(id(self))
-        out_shapes = head if isinstance(head, list) else [head]
+        if isinstance(head, list):
+            if (self._op not in (None, "group", "output_slice")
+                    and self._op.visible_outputs is not None):
+                head = head[:self._op.visible_outputs]  # drop hidden outputs
+            out_shapes = head
+        else:
+            out_shapes = [head]
         return ([name2shape[a] for a in args], out_shapes,
                 [name2shape[a] for a in auxs])
 
