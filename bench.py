@@ -4,51 +4,62 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 The measured config mirrors BASELINE's north star (BERT-base pretrain):
 batch x seq MLM step — forward + backward + Adam, fused into a single XLA
-program by parallel.TrainStep.  vs_baseline is measured MFU / 0.45 (the
+program by parallel.TrainStep, with MXNET_BENCH_SCAN_STEPS steps scanned
+inside each dispatch (lax.scan) so the tunnel/dispatch latency of the axon
+platform is amortized away.  vs_baseline is measured MFU / 0.45 (the
 BASELINE target: >= 45% MFU => vs_baseline >= 1.0).
 
+MFU accounting follows the PaLM convention: matmul params only (embedding
+and position tables are gathers, not matmuls — excluded from the 6N term;
+the untied MLM decoder matmul is kept) plus the 12*l*C*S attention term.
+Peak: TPU v5e = 197 TFLOP/s bf16 (394 is the int8 number), v4 = 275,
+v5p = 459.
+
+The whole measurement retries with backoff (and then a halved batch) on
+infra errors — the axon remote-compile tunnel can flake, and a crashed bench
+records nothing.
+
 Env knobs:
-  MXNET_BENCH_MODEL   bert_12_768_12 (default) | bert_6_512_8 | bert_3_128_2
-  MXNET_BENCH_BATCH   default 8
-  MXNET_BENCH_SEQLEN  default 128
-  MXNET_BENCH_DTYPE   bfloat16 (default) | float32
-  MXNET_BENCH_STEPS   timed steps, default 8
+  MXNET_BENCH_MODEL       bert_12_768_12 (default) | bert_6_512_8 | bert_3_128_2
+  MXNET_BENCH_BATCH       default 128
+  MXNET_BENCH_SEQLEN      default 128
+  MXNET_BENCH_DTYPE       bfloat16 (default) | float32
+  MXNET_BENCH_SCAN_STEPS  steps fused per dispatch, default 16
+  MXNET_BENCH_DISPATCHES  timed dispatches, default 2
 """
 
 import json
 import os
+import sys
 import time
+import traceback
 
 import numpy as np
 
 
 def _peak_flops(dtype):
-    """Per-chip peak for MFU accounting. v5e (axon 'TPU v5 lite'): 394
-    TFLOP/s bf16; fp32 ~1/4 of bf16 on the MXU.  CPU fallback: nominal."""
+    """Per-chip peak for MFU accounting."""
     import jax
     d = jax.devices()[0]
     if d.platform == "cpu":
         return 5e11
-    bf16_peak = 394e12  # TPU v5e
-    if "v4" in str(getattr(d, "device_kind", "")).lower():
+    kind = str(getattr(d, "device_kind", "")).lower()
+    if "v4" in kind:
         bf16_peak = 275e12
+    elif "v5p" in kind:
+        bf16_peak = 459e12
+    else:  # v5e / "TPU v5 lite"
+        bf16_peak = 197e12
     return bf16_peak if dtype == "bfloat16" else bf16_peak / 4
 
 
-def main():
+def run_once(name, batch, seq_len, dtype, scan_steps, dispatches):
     import mxnet_tpu as mx
     from mxnet_tpu import nd, parallel
     from mxnet_tpu.gluon.model_zoo import bert
 
-    name = os.environ.get("MXNET_BENCH_MODEL", "bert_12_768_12")
-    batch = int(os.environ.get("MXNET_BENCH_BATCH", "128"))
-    seq_len = int(os.environ.get("MXNET_BENCH_SEQLEN", "128"))
-    dtype = os.environ.get("MXNET_BENCH_DTYPE", "bfloat16")
-    steps = int(os.environ.get("MXNET_BENCH_STEPS", "8"))
     vocab = 30522
-
     if dtype == "bfloat16":
-        # bf16 compute with fp32 master weights (multi_precision)
         import jax
         jax.config.update("jax_default_matmul_precision", "default")
 
@@ -72,51 +83,84 @@ def main():
                             multi_precision=(dtype == "bfloat16"))
     step = parallel.TrainStep(model, loss_fn, opt, mesh=mesh)
 
-    tokens = nd.array(np.random.randint(0, vocab, (batch, seq_len)),
-                      dtype="int32")
-    labels = nd.array(np.random.randint(0, vocab, (batch, seq_len)),
-                      dtype="int32")
+    # per-step batches (stacked, scanned over) so every step sees fresh data
+    def mk_batches(seed):
+        r = np.random.RandomState(seed)
+        toks = r.randint(0, vocab, (scan_steps, batch, seq_len)).astype(np.int32)
+        labs = r.randint(0, vocab, (scan_steps, batch, seq_len)).astype(np.int32)
+        return nd.array(toks), nd.array(labs)
 
-    def sync():
-        # wait for the full step (params updated), not just the loss value
-        import jax
-        jax.block_until_ready(
-            [p._data._data for p in model.collect_params().values()])
-        loss.wait_to_read()
+    warm_t, warm_l = mk_batches(0)
+    losses = step.run(warm_t, warm_l)           # compile + warmup dispatch
+    float(np.asarray(losses.asnumpy()[-1]))      # full fetch barrier
 
-    # warmup (compile)
-    for _ in range(2):
-        loss = step(tokens, labels)
-    sync()
-
+    batches = [mk_batches(i + 1) for i in range(dispatches)]
     t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(tokens, labels)
-    sync()
+    for t, l in batches:
+        losses = step.run(t, l)
+    last_loss = float(np.asarray(losses.asnumpy()[-1], np.float64))  # barrier
     dt = time.perf_counter() - t0
 
-    samples_per_sec = batch * steps / dt
+    n_steps = scan_steps * dispatches
+    samples_per_sec = batch * n_steps / dt
 
-    # MFU: flops/token ~= 6*N (fwd+bwd matmuls) + attention 12*l*C*S
+    # MFU: matmul-param 6N term (no embedding/position gathers) + attention
     cfg = bert._BERT_CONFIGS[name]
     n_layers, units, hidden, _heads = cfg
-    n_params = sum(int(np.prod(p.shape))
-                   for p in model.collect_params().values()
-                   if p.shape is not None)
-    flops_per_token = 6 * n_params + 12 * n_layers * units * seq_len
+    n_matmul = 0
+    for pname, p in model.collect_params().items():
+        if p.shape is None:
+            continue
+        if "word_" in pname or "position_weight" in pname:
+            continue  # gather tables, not matmuls (PaLM MFU convention)
+        n_matmul += int(np.prod(p.shape))
+    flops_per_token = 6 * n_matmul + 12 * n_layers * units * seq_len
     tokens_per_sec = samples_per_sec * seq_len
     mfu = tokens_per_sec * flops_per_token / _peak_flops(dtype)
 
-    print(json.dumps({
+    return {
         "metric": f"{name}_train_samples_per_sec_per_chip",
         "value": round(samples_per_sec, 3),
         "unit": "samples/s",
         "vs_baseline": round(mfu / 0.45, 4),
         "extra": {"mfu": round(mfu, 4), "dtype": dtype, "batch": batch,
-                  "seq_len": seq_len, "step_ms": round(1000 * dt / steps, 2),
-                  "loss": float(np.asarray(loss.asnumpy(), np.float64))},
+                  "seq_len": seq_len, "scan_steps": scan_steps,
+                  "step_ms": round(1000 * dt / n_steps, 2),
+                  "loss": last_loss},
+    }
+
+
+def main():
+    name = os.environ.get("MXNET_BENCH_MODEL", "bert_12_768_12")
+    batch = int(os.environ.get("MXNET_BENCH_BATCH", "128"))
+    seq_len = int(os.environ.get("MXNET_BENCH_SEQLEN", "128"))
+    dtype = os.environ.get("MXNET_BENCH_DTYPE", "bfloat16")
+    scan_steps = int(os.environ.get("MXNET_BENCH_SCAN_STEPS", "16"))
+    dispatches = int(os.environ.get("MXNET_BENCH_DISPATCHES", "2"))
+
+    # (batch, note) ladder: same config twice (transient tunnel flakes),
+    # then halved batch (memory/oversize fallback)
+    attempts = [(batch, None), (batch, "retry"), (max(batch // 2, 1), "half-batch")]
+    last_err = None
+    for i, (b, note) in enumerate(attempts):
+        try:
+            result = run_once(name, b, seq_len, dtype, scan_steps, dispatches)
+            if note:
+                result["extra"]["note"] = note
+            print(json.dumps(result))
+            return 0
+        except Exception as e:  # noqa: BLE001 — must survive infra flakes
+            last_err = e
+            traceback.print_exc(file=sys.stderr)
+            if i + 1 < len(attempts):
+                time.sleep(5 * (i + 1))
+    print(json.dumps({
+        "metric": f"{name}_train_samples_per_sec_per_chip",
+        "value": 0.0, "unit": "samples/s", "vs_baseline": 0.0,
+        "extra": {"error": f"{type(last_err).__name__}: {last_err}"[:300]},
     }))
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -55,6 +55,11 @@ KNOWN_VARS = {
         "jax matmul precision for float32 ops: default|high|highest. "
         "'highest' gives true-f32 MXNet numerics (3/6-pass bf16 on the MXU); "
         "set 'default' to trade accuracy for raw MXU throughput."),
+    "MXNET_FUSED_ATTENTION": (
+        "1", int,
+        "If 1 (default), contrib.masked_selfatt lowers to the Pallas flash "
+        "attention kernel on TPU (seq multiple of 128); 0 forces the dense "
+        "masked-softmax fallback everywhere."),
     "MXNET_TPU_JIT_IMPERATIVE": (
         "1", int,
         "If 1, imperative op dispatch goes through a per-(op,shape,dtype,attrs) "

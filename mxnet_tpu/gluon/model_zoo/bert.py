@@ -50,14 +50,15 @@ class BERTEncoderCell(HybridBlock):
             self.layer_norm_ffn = LayerNorm(in_channels=units, prefix="ln2_")
             self.drop = Dropout(dropout)
 
-    def hybrid_forward(self, F, x):
-        # x: (L, B, C) time-major (reference transformer.cc layout contract)
+    def hybrid_forward(self, F, x, valid_length=None):
+        # x: (L, B, C) time-major (reference transformer.cc layout contract).
+        # valid_length (B,): padding positions neither attend nor are
+        # attended to (GluonNLP BERT masking contract).
         qkv = self.attn_qkv(x)
-        att = F.contrib.interleaved_matmul_selfatt_qk(
-            qkv, heads=self._num_heads)
-        att = F.softmax(att, axis=-1)
-        ctx_vec = F.contrib.interleaved_matmul_selfatt_valatt(
-            qkv, att, heads=self._num_heads)
+        if valid_length is None:
+            valid_length = F.full((x.shape[1],), x.shape[0], dtype="int32")
+        ctx_vec = F.contrib.masked_selfatt(qkv, valid_length,
+                                           heads=self._num_heads)
         out = self.layer_norm_att(x + self.drop(self.attn_proj(ctx_vec)))
         h = self.ffn_2(F.gelu(self.ffn_1(out)))
         return self.layer_norm_ffn(out + self.drop(h))
@@ -75,16 +76,18 @@ class BERTEncoder(HybridBlock):
                 self.register_child(cell, f"layer{i}")
                 self.cells.append(cell)
 
-    def hybrid_forward(self, F, x):
+    def hybrid_forward(self, F, x, valid_length=None):
         for cell in self.cells:
-            x = cell(x)
+            x = cell(x) if valid_length is None else cell(x, valid_length)
         return x
 
 
 class BERTModel(HybridBlock):
     """Embeddings + encoder + pooler + MLM decoder.
 
-    ``forward(tokens)`` (batch-major (B, L) int tokens) returns
+    ``forward(tokens)`` or ``forward(tokens, valid_length)`` (batch-major
+    (B, L) int tokens; valid_length (B,) sequence lengths — padded positions
+    are masked out of attention, the GluonNLP BERT contract) returns
     ``(sequence_output (B, L, C), pooled (B, C), mlm_logits (B, L, V))``.
     """
 
@@ -107,14 +110,16 @@ class BERTModel(HybridBlock):
             self.decoder = Dense(vocab_size, flatten=False, in_units=units,
                                  prefix="decoder_")
 
-    def hybrid_forward(self, F, tokens, position_weight):
+    def hybrid_forward(self, F, tokens, valid_length=None,
+                       position_weight=None):
         seq_len = tokens.shape[1]
         x = self.word_embed(tokens)
         pos = F.slice_axis(position_weight, axis=0, begin=0, end=seq_len)
         x = x + F.expand_dims(pos, axis=0)
         x = self.embed_drop(self.embed_norm(x))
         x = F.transpose(x, axes=(1, 0, 2))       # (B,L,C) -> (L,B,C)
-        x = self.encoder(x)
+        x = self.encoder(x, valid_length) if valid_length is not None \
+            else self.encoder(x)
         x = F.transpose(x, axes=(1, 0, 2))       # back to (B,L,C)
         first = F.reshape(F.slice_axis(x, axis=1, begin=0, end=1),
                           shape=(0, -1))

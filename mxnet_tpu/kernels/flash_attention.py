@@ -1,0 +1,353 @@
+"""FlashAttention-2 for TPU in Pallas — forward + full custom backward.
+
+Blockwise-softmax attention with O(L) memory: probabilities never
+materialize in HBM (SURVEY §5.7; replaces the reference's full
+softmax(QK^T) path in src/operator/contrib/transformer.cc).  Written
+in-house rather than wrapping jax.experimental's kernel because (a) this
+framework runs with jax_enable_x64 on (MXNet float64 parity) and the
+upstream kernel's index arithmetic miscompiles under x64 — everything here
+pins explicit int32/float32 types — and (b) it is the building block the
+ring-attention sequence-parallel path composes with.
+
+Layout: q, k, v are (batch, heads, seq, head_dim); segment ids are
+(batch, seq) int32 — attention only flows between positions with EQUAL
+segment ids (padding mask: valid tokens segment 1, pad tokens 0).
+
+Grid design (canonical TPU flash schedule): grid (B, H, n_q, n_kv) with the
+kv dimension innermost — TPU grid steps run sequentially per core, so the
+running (m, l, acc) live in VMEM scratch across kv steps and the output
+block writes once on the last kv step.  All matmuls hit the MXU at
+(block, block) granularity with float32 accumulation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+_LANES = 128     # VPU lane width: per-row scalars are stored broadcast over lanes
+_SUBLANES = 8    # min sublane count — kv segment ids ride a (8, bk) tile
+
+
+def _mask_block(sq_ref, skv_ref, causal, iq, ik, bq, bk):
+    """(bq, bk) bool mask for one tile; int32 iota only (x64-safe).
+
+    sq_ref block is (1, bq, LANES) (q ids broadcast over lanes), skv_ref is
+    (1, SUBLANES, bk) (kv ids broadcast over sublanes) — the tile-legal
+    layout trick for 1-per-row scalars."""
+    sq = sq_ref[0][:, :1]          # (bq, 1)
+    skv = skv_ref[0][:1, :]        # (1, bk)
+    mask = sq == skv
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+        ki = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
+        mask = jnp.logical_and(mask, qi >= ki)
+    return mask
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, causal, scale, n_kv):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0]                     # (bq, d)
+    k = k_ref[0, 0]                     # (bk, d)
+    v = v_ref[0, 0]
+    bq, bk = q.shape[0], k.shape[0]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT) * jnp.float32(scale)          # (bq, bk)
+    mask = _mask_block(sq_ref, skv_ref, causal, iq, ik, bq, bk)
+    s = jnp.where(mask, s, jnp.float32(_NEG_INF))
+
+    m_prev = m_scr[:, :1]                                     # (bq, 1)
+    l_prev = l_scr[:, :1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)                 # (bq, 1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # rows with every position masked stay at -inf; exp would overflow NaN
+    p = jnp.exp(s - m_new)                                    # (bq, bk) f32
+    p = jnp.where(mask, p, jnp.float32(0.0))
+    alpha = jnp.exp(m_prev - m_new)                           # (bq, 1)
+    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+
+    acc = acc_scr[...] * alpha
+    acc_scr[...] = acc + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT)
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == n_kv - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == jnp.float32(0.0), jnp.float32(1.0), l)                  # fully-masked rows
+        o_ref[0, 0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        lse = m_scr[:, :1] + jnp.log(safe_l)                  # (bq, 1)
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _fwd(q, k, v, seg_q, seg_kv, causal, scale, block_q, block_k, interpret):
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    bq, bk = min(block_q, Lq), min(block_k, Lk)
+    n_q, n_kv = Lq // bq, Lk // bk
+    grid = (B, H, n_q, n_kv)
+    seg_q = jnp.broadcast_to(seg_q[:, :, None], (B, Lq, _LANES))
+    seg_kv = jnp.broadcast_to(seg_kv[:, None, :], (B, _SUBLANES, Lk))
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                          n_kv=n_kv),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, h, i, j: (b, i, 0)),
+            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, i, j: (b, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, _LANES), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Lq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, seg_q, seg_kv)
+    return out, lse[..., 0]  # lse (B, H, Lq)
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               sq_ref, skv_ref, dq_ref, dq_scr, *, causal, scale, n_kv):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)                     # (bq, d)
+    lse = lse_ref[0, 0][:, :1]                                # (bq, 1)
+    delta = delta_ref[0, 0][:, :1]                            # (bq, 1)
+    bq, bk = q.shape[0], k.shape[0]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT) * jnp.float32(scale)
+    mask = _mask_block(sq_ref, skv_ref, causal, iq, ik, bq, bk)
+    p = jnp.where(mask, jnp.exp(s - lse), jnp.float32(0.0))                # (bq, bk)
+    dp = jax.lax.dot_general(
+        do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT)                   # (bq, bk)
+    ds = p * (dp - delta) * jnp.float32(scale)
+    dq_scr[...] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT)
+
+    @pl.when(ik == n_kv - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                sq_ref, skv_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                *, causal, scale, n_q):
+    ik = pl.program_id(2)   # kv block: outer
+    iq = pl.program_id(3)   # q block: inner (sequential accumulation)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]                                         # (bq, d)
+    lse = lse_ref[0, 0][:, :1]                                # (bq, 1)
+    delta = delta_ref[0, 0][:, :1]
+    bq, bk = q.shape[0], k.shape[0]
+
+    # transposed tile: sT (bk, bq)
+    sT = jax.lax.dot_general(
+        k, q, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT) * jnp.float32(scale)
+    mask = _mask_block(sq_ref, skv_ref, causal, iq, ik, bq, bk)
+    pT = jnp.where(mask.T, jnp.exp(sT - lse[:, 0][None, :]), jnp.float32(0.0))  # (bk, bq)
+    dv_scr[...] += jax.lax.dot_general(
+        pT.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT)
+    dpT = jax.lax.dot_general(
+        v, do, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT)                   # (bk, bq)
+    dsT = pT * (dpT - delta[:, 0][None, :]) * jnp.float32(scale)
+    dk_scr[...] += jax.lax.dot_general(
+        dsT.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT)
+
+    @pl.when(iq == n_q - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, seg_q, seg_kv, out, lse, do, causal, scale,
+         block_q, block_k, interpret):
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    bq, bk = min(block_q, Lq), min(block_k, Lk)
+    n_q, n_kv = Lq // bq, Lk // bk
+
+    # delta_i = rowsum(dO * O): cheap elementwise reduce, XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                                   # (B, H, Lq)
+    lse_b = jnp.broadcast_to(lse[..., None], lse.shape + (_LANES,))
+    delta_b = jnp.broadcast_to(delta[..., None], delta.shape + (_LANES,))
+    seg_q = jnp.broadcast_to(seg_q[:, :, None], (B, Lq, _LANES))
+    seg_kv = jnp.broadcast_to(seg_kv[:, None, :], (B, _SUBLANES, Lk))
+
+    row_spec = pl.BlockSpec((1, 1, bq, _LANES), lambda b, h, i, j: (b, h, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale, n_kv=n_kv),
+        grid=(B, H, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            row_spec,
+            row_spec,
+            pl.BlockSpec((1, bq, _LANES), lambda b, h, i, j: (b, i, 0)),
+            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, i, j: (b, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse_b, delta_b, seg_q, seg_kv)
+
+    row_spec_T = pl.BlockSpec((1, 1, bq, _LANES),
+                              lambda b, h, j, i: (b, h, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=scale, n_q=n_q),
+        grid=(B, H, n_kv, n_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            row_spec_T,
+            row_spec_T,
+            pl.BlockSpec((1, bq, _LANES), lambda b, h, j, i: (b, i, 0)),
+            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, j, i: (b, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse_b, delta_b, seg_q, seg_kv)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention(q, k, v, seg_q=None, seg_kv=None, causal=False,
+                    sm_scale=1.0, block_q=128, block_k=128,
+                    interpret=False):
+    """Blockwise (flash) attention: softmax(scale * Q K^T + mask) V.
+
+    q, k, v: (B, H, L, D); seg_q/seg_kv: (B, L) int32 segment ids (None =
+    no masking); positions attend only within equal segment ids.  Returns
+    (B, H, Lq, D) in q's dtype.  ``interpret=True`` runs the Pallas
+    interpreter (CPU tests).
+    """
+    out, _ = _flash_fwd(q, k, v, seg_q, seg_kv, causal, sm_scale,
+                        block_q, block_k, interpret)
+    return out
+
+
+def _canon_segs(q, k, seg_q, seg_kv):
+    B, _, Lq, _ = q.shape
+    Lk = k.shape[2]
+    if seg_q is None:
+        seg_q = jnp.zeros((B, Lq), jnp.int32)
+        seg_kv = jnp.zeros((B, Lk), jnp.int32)
+    return seg_q.astype(jnp.int32), seg_kv.astype(jnp.int32)
+
+
+def _flash_fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k,
+               interpret):
+    sq, skv = _canon_segs(q, k, seg_q, seg_kv)
+    out, lse = _fwd(q, k, v, sq, skv, causal, float(sm_scale),
+                    block_q, block_k, interpret)
+    return out, (q, k, v, sq, skv, out, lse)
+
+
+def _flash_fwd_rule(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
+                    block_k, interpret):
+    out, res = _flash_fwd(q, k, v, seg_q, seg_kv, causal, sm_scale,
+                          block_q, block_k, interpret)
+    return out, res
+
+
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v, sq, skv, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, sq, skv, out, lse, g, causal,
+                      float(sm_scale), block_q, block_k, interpret)
+    return dq, dk, dv, None, None
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -2,10 +2,12 @@
 
 Rebuild of src/operator/contrib/ — most importantly transformer.cc's fused
 attention ops (`_contrib_interleaved_matmul_selfatt_qk` etc., the GluonNLP
-BERT fast path, SURVEY §5.7) and the detection-model box ops.  On TPU the
-attention ops route through one fused attention impl (see
-mxnet_tpu.parallel.attention for the Pallas/flash path); the interleaved
-layout contracts of the reference are preserved at the op boundary.
+BERT fast path, SURVEY §5.7) and the detection-model box ops.  The
+``contrib.masked_selfatt`` op is the fully-fused TPU path: on TPU it lowers
+to the Pallas flash-attention kernel (O(L) memory, MXU-tiled) with
+valid_length masking via segment ids; elsewhere it runs the dense masked
+softmax(QK^T)V in fp32.  The interleaved layout contracts of the reference
+are preserved at every op boundary.
 """
 
 from __future__ import annotations
@@ -57,6 +59,84 @@ def _interleaved_matmul_selfatt_valatt(qkv, att, heads=1):
     a = att.reshape(B, heads, L, L)
     out = jnp.einsum("bhqk,kbhd->qbhd", a, v)
     return out.reshape(L, B, -1)
+
+
+def _flash_eligible(seq, head_dim):
+    """Whether the Pallas TPU flash kernel's tiling applies to these shapes
+    (lane-aligned seq blocks); the platform choice itself happens at XLA
+    lowering via lax.platform_dependent, never by host-side guessing."""
+    from .. import config
+    if not config.get_int("MXNET_FUSED_ATTENTION", 1):
+        return False
+    return seq >= 128 and seq % 128 == 0 and head_dim % 8 == 0
+
+
+def _dense_sdpa(q, k, v, seg, causal, scale):
+    """Masked softmax(QK^T)V, fp32 softmax — the portable fallback and the
+    numerics oracle for the flash path (tests compare the two)."""
+    import jax
+    jnp = _jnp()
+    att = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    neg = jnp.asarray(-1e9, jnp.float32)
+    if seg is not None:
+        mask = seg[:, None, :, None] == seg[:, None, None, :]
+        att = jnp.where(mask, att, neg)
+    if causal:
+        L = att.shape[-1]
+        cm = jnp.tril(jnp.ones((L, L), bool))
+        att = jnp.where(cm[None, None], att, neg)
+    p = jax.nn.softmax(att, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@register("contrib.masked_selfatt")
+def _masked_selfatt(qkv, valid_length, heads=1, causal=False):
+    """Fused masked multi-head self-attention.
+
+    The single-op TPU replacement for the reference's
+    interleaved_matmul_selfatt_qk → (mask) → softmax →
+    interleaved_matmul_selfatt_valatt chain (src/operator/contrib/
+    transformer.cc; GluonNLP applies the valid_length mask between qk and
+    softmax).  Inputs keep the reference interleaved layout contract:
+    ``qkv`` is (L, B, 3*heads*head_dim) with per-head [q,k,v] interleaving;
+    ``valid_length`` is (B,) — positions >= valid_length[b] neither attend
+    nor are attended to.  Returns the attention context (L, B, heads*head_dim).
+
+    On TPU this lowers to the Pallas flash-attention kernel (blockwise
+    softmax, O(L) memory — SURVEY §5.7's long-context requirement); the
+    masking rides the kernel's segment-id support so padding never
+    materializes an (L, L) mask.
+    """
+    jnp = _jnp()
+    L, B, E = qkv.shape
+    D = E // (3 * heads)
+    q, k, v = _split_interleaved(qkv, heads)       # (L, B, H, D)
+    q = jnp.transpose(q, (1, 2, 0, 3))             # (B, H, L, D)
+    k = jnp.transpose(k, (1, 2, 0, 3))
+    v = jnp.transpose(v, (1, 2, 0, 3))
+    scale = 1.0 / float(D) ** 0.5
+    steps = jnp.arange(L, dtype=jnp.int32)
+    seg = (steps[None, :] < valid_length.astype(jnp.int32)[:, None]) \
+        .astype(jnp.int32)                          # (B, L): 1=valid, 0=pad
+    if _flash_eligible(L, D):
+        import jax
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention, SegmentIds)
+
+        def _tpu(q, k, v, seg):
+            return flash_attention(q, k, v, segment_ids=SegmentIds(seg, seg),
+                                   causal=causal, sm_scale=scale)
+
+        def _portable(q, k, v, seg):
+            return _dense_sdpa(q, k, v, seg, causal, scale)
+
+        # branch resolved per compile platform at lowering time: TPU gets the
+        # Pallas kernel, CPU (tests, host-side eval) the dense fallback
+        out = jax.lax.platform_dependent(q, k, v, seg,
+                                         tpu=_tpu, default=_portable)
+    else:
+        out = _dense_sdpa(q, k, v, seg, causal, scale)
+    return jnp.transpose(out, (2, 0, 1, 3)).reshape(L, B, heads * D)
 
 
 @register("contrib.interleaved_matmul_encdec_qk")

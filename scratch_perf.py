@@ -1,0 +1,97 @@
+"""Scratch: measure raw chip peak + pure-jax BERT step vs framework bench."""
+import time, functools
+import numpy as np
+import jax, jax.numpy as jnp
+
+print("devices:", jax.devices())
+
+# 1. raw matmul peak (bf16)
+N = 4096
+a = jnp.ones((N, N), jnp.bfloat16)
+b = jnp.ones((N, N), jnp.bfloat16)
+
+@jax.jit
+def mm(a, b):
+    for _ in range(8):
+        a = (a @ b) * 0.001
+    return a
+
+mm(a, b).block_until_ready()
+t0 = time.perf_counter()
+r = mm(a, b)
+r.block_until_ready()
+dt = time.perf_counter() - t0
+flops = 8 * 2 * N**3
+print(f"matmul: {flops/dt/1e12:.1f} TFLOP/s")
+
+# 2. pure-jax BERT-base train step (dense attention, bf16, adam fp32 master)
+L_layers, C, H, A = 12, 768, 3072, 12
+V, B, S = 30522, 128, 128
+rng = np.random.RandomState(0)
+
+def mk(shape, dtype=jnp.bfloat16):
+    return jnp.asarray(rng.normal(0, 0.02, shape), dtype)
+
+params = {"emb": mk((V, C)), "pos": mk((S, C)), "dec": mk((C, V))}
+for i in range(L_layers):
+    params[f"l{i}"] = {
+        "qkv": mk((C, 3 * C)), "proj": mk((C, C)),
+        "f1": mk((C, H)), "f2": mk((H, C)),
+        "ln1s": jnp.ones(C, jnp.bfloat16), "ln1b": jnp.zeros(C, jnp.bfloat16),
+        "ln2s": jnp.ones(C, jnp.bfloat16), "ln2b": jnp.zeros(C, jnp.bfloat16),
+    }
+
+def ln(x, s, b):
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + 1e-5) * s + b
+
+def fwd(p, tokens, labels):
+    x = p["emb"][tokens] + p["pos"][None]
+    for i in range(L_layers):
+        lp = p[f"l{i}"]
+        qkv = x @ lp["qkv"]
+        q, k, v = jnp.split(qkv.reshape(B, S, A, 3 * C // A // 3 * 3 // 3 * 1 * 3).reshape(B, S, A, -1), 3, -1) if False else (None, None, None)
+        qkv = qkv.reshape(B, S, 3, A, C // A).transpose(2, 0, 3, 1, 4)  # 3,B,A,S,D
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(C // A)
+        att = jax.nn.softmax(att.astype(jnp.float32), -1).astype(jnp.bfloat16)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, C)
+        x = ln(x + ctx @ lp["proj"], lp["ln1s"], lp["ln1b"])
+        h = jax.nn.gelu(x @ lp["f1"]) @ lp["f2"]
+        x = ln(x + h, lp["ln2s"], lp["ln2b"])
+    logits = (x @ p["dec"]).astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, -1)
+    ll = jnp.take_along_axis(logits, labels[..., None], -1)[..., 0]
+    return (lse - ll).mean()
+
+adam_m = jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), params)
+adam_v = jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), params)
+master = jax.tree.map(lambda x: x.astype(jnp.float32), params)
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def step(p, mw, m, v, tokens, labels):
+    loss, g = jax.value_and_grad(fwd)(p, tokens, labels)
+    m = jax.tree.map(lambda a, b: 0.9 * a + 0.1 * b.astype(jnp.float32), m, g)
+    v = jax.tree.map(lambda a, b: 0.999 * a + 0.001 * (b.astype(jnp.float32) ** 2), v, g)
+    mw = jax.tree.map(lambda w, mm_, vv: w - 1e-4 * mm_ / (jnp.sqrt(vv) + 1e-8), mw, m, v)
+    p = jax.tree.map(lambda w: w.astype(jnp.bfloat16), mw)
+    return p, mw, m, v, loss
+
+tokens = jnp.asarray(rng.randint(0, V, (B, S)), jnp.int32)
+labels = jnp.asarray(rng.randint(0, V, (B, S)), jnp.int32)
+
+params, master, adam_m, adam_v, loss = step(params, master, adam_m, adam_v, tokens, labels)
+jax.block_until_ready(loss)
+STEPS = 16
+t0 = time.perf_counter()
+for _ in range(STEPS):
+    params, master, adam_m, adam_v, loss = step(params, master, adam_m, adam_v, tokens, labels)
+jax.block_until_ready(loss)
+dt = (time.perf_counter() - t0) / STEPS
+sps = B / dt
+n_matmul = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params)) - V * C - S * C
+fpt = 6 * n_matmul + 12 * L_layers * C * S
+mfu = sps * S * fpt / 394e12
+print(f"pure-jax BERT step: {dt*1000:.1f} ms, {sps:.0f} samples/s, mfu={mfu:.3f} (loss {loss:.3f})")
