@@ -1,0 +1,362 @@
+"""Automatic mixed precision — TPU-native, bf16-first.
+
+Reference: ``python/mxnet/contrib/amp/amp.py`` (P17) + the
+``src/nnvm/low_precision_pass.cc`` graph pass (N10) + the per-op dtype
+lists in ``contrib/amp/lists/symbol_fp16.py``.
+
+TPU-native design (SURVEY §7.1 AMP row): instead of monkey-patching every
+generated op namespace (the reference's trick) or rewriting nnvm graphs,
+casts are inserted at the single imperative-dispatch chokepoint
+(``ops.registry.invoke``) that BOTH the eager path and the ``hybridize()``
+trace flow through.  ``amp.init()`` installs a cast hook that, per op:
+
+ - casts float32/float64 inputs of matmul/conv-heavy ops (``TARGET_OPS``)
+   down to the target dtype — these hit the MXU, where bf16 is the fast
+   path;
+ - casts low-precision inputs of numerically sensitive ops (``FP32_OPS``:
+   softmax, norms, exp/log, losses) up to float32;
+ - casts all float inputs of dtype-agnostic multi-input ops
+   (``WIDEST_OPS``) to the widest float dtype present (the reference's
+   ``amp_multicast`` semantics).
+
+Because the hook runs inside the jit trace, XLA sees the casts as part of
+the program and fuses them into neighbors — there is no eager cast cost.
+
+The default target is **bfloat16**: same exponent range as float32, so no
+loss scaling is needed and ``LossScaler`` stays at scale 1.  ``float16`` is
+accepted for API parity and enables the reference's dynamic loss-scaling
+algorithm (scale halves on overflow, doubles after ``scale_window`` clean
+steps — ``contrib/amp/loss_scaler.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "LossScaler",
+           "convert_model", "convert_hybrid_block",
+           "list_lp16_ops", "list_fp32_ops", "list_widest_ops"]
+
+# ---------------------------------------------------------------------------
+# op lists (reference contrib/amp/lists/symbol_fp16.py, curated to this
+# registry's op surface)
+# ---------------------------------------------------------------------------
+
+# matmul/conv-dominated ops: run in the target low precision (MXU fast path)
+TARGET_OPS = {
+    "dot", "batch_dot", "matmul", "einsum",
+    "FullyConnected", "Convolution", "Deconvolution", "RNN",
+    "contrib.interleaved_matmul_selfatt_qk",
+    "contrib.interleaved_matmul_selfatt_valatt",
+    "contrib.interleaved_matmul_encdec_qk",
+    "contrib.interleaved_matmul_encdec_valatt",
+    "contrib.masked_selfatt",
+}
+
+# numerically sensitive ops: always accumulate in float32
+FP32_OPS = {
+    "softmax", "log_softmax", "softmin", "SoftmaxActivation", "SoftmaxOutput",
+    "softmax_cross_entropy", "gumbel_softmax",
+    "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "L2Normalization",
+    "LRN", "norm", "linalg.norm", "mean", "sum", "sum_axis", "nansum",
+    "logsumexp", "cumsum",
+    "exp", "expm1", "log", "log1p", "log2", "log10",
+    "erf", "erfinv", "rsqrt", "sqrt", "square",
+    "linalg.slogdet", "linalg.sumlogdiag",
+}
+
+# dtype-agnostic multi-input ops: promote every float input to the widest
+# float dtype present (amp_multicast semantics)
+WIDEST_OPS = {
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "add_n", "concat", "stack", "where",
+}
+
+_FLOAT_KINDS = ("f",)  # numpy kind for float dtypes (bf16 reports 'V' via
+                       # ml_dtypes? no — ml_dtypes registers kind 'f')
+
+
+def list_lp16_ops():
+    """Ops cast to the low-precision target (reference list_fp16_ops)."""
+    return sorted(TARGET_OPS)
+
+
+def list_fp32_ops():
+    return sorted(FP32_OPS)
+
+
+def list_widest_ops():
+    return sorted(WIDEST_OPS)
+
+
+# ---------------------------------------------------------------------------
+# state + dispatch hook
+# ---------------------------------------------------------------------------
+
+class _AmpState:
+    __slots__ = ("active", "target_dtype", "target_ops", "fp32_ops",
+                 "widest_ops")
+
+    def __init__(self):
+        self.active = False
+        self.target_dtype = None
+        self.target_ops = frozenset()
+        self.fp32_ops = frozenset()
+        self.widest_ops = frozenset()
+
+
+_state = _AmpState()
+
+
+def _is_float(dt):
+    try:
+        d = _np.dtype(dt)
+    except TypeError:
+        return False
+    if d.kind == "f":
+        return True
+    # ml_dtypes extended floats (bfloat16 et al.) report numpy kind 'V'
+    import ml_dtypes
+    return d == _np.dtype(ml_dtypes.bfloat16)
+
+
+def _cast_hook(op_name, arrays):
+    """Installed as ops.registry dispatch hook; must be jax-traceable."""
+    import jax.numpy as jnp
+    st = _state
+    if op_name in st.target_ops:
+        tgt = st.target_dtype
+        return [a.astype(tgt)
+                if hasattr(a, "dtype") and _is_float(a.dtype)
+                and _np.dtype(a.dtype).itemsize > 2 else a
+                for a in arrays]
+    if op_name in st.fp32_ops:
+        return [a.astype(jnp.float32)
+                if hasattr(a, "dtype") and _is_float(a.dtype)
+                and _np.dtype(a.dtype).itemsize < 4 else a
+                for a in arrays]
+    if op_name in st.widest_ops:
+        fdts = [_np.dtype(a.dtype) for a in arrays
+                if hasattr(a, "dtype") and _is_float(a.dtype)]
+        if len(fdts) > 1 and len(set(fdts)) > 1:
+            widest = max(fdts, key=lambda d: d.itemsize)
+            return [a.astype(widest)
+                    if hasattr(a, "dtype") and _is_float(a.dtype) else a
+                    for a in arrays]
+    return arrays
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP process-wide (reference amp.init()).
+
+    target_dtype : 'bfloat16' (TPU default) or 'float16' (API parity; the
+        reference only knows float16).
+    target_precision_ops : extra op names to run in the target dtype.
+    conditional_fp32_ops / fp32_ops : extra op names forced to float32
+        (the reference's conditional triples collapse to names here — the
+        conditions were cuDNN-specific).
+    """
+    import ml_dtypes
+    from .ops import registry as _reg
+
+    if hasattr(target_dtype, "name"):
+        target_dtype = _np.dtype(target_dtype).name
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError(
+            f"amp target_dtype must be bfloat16 or float16, got {target_dtype!r}")
+    tgt = ml_dtypes.bfloat16 if target_dtype == "bfloat16" else _np.float16
+
+    st = _state
+    st.target_dtype = tgt
+    st.target_ops = frozenset(TARGET_OPS) | frozenset(target_precision_ops or ())
+    extra_fp32 = set(fp32_ops or ())
+    for item in (conditional_fp32_ops or ()):
+        # reference passes (op_name, attr, values) triples
+        extra_fp32.add(item[0] if isinstance(item, (tuple, list)) else item)
+    st.fp32_ops = (frozenset(FP32_OPS) | extra_fp32) - st.target_ops
+    st.widest_ops = frozenset(WIDEST_OPS) - st.target_ops - st.fp32_ops
+    st.active = True
+
+    _reg.set_dispatch_cast_hook(_cast_hook)
+
+    # matmul accumulation stays f32 on MXU; inputs are what we cast
+    import jax
+    jax.config.update("jax_default_matmul_precision", "default")
+
+
+def off():
+    """Disable AMP (test helper; reference has no un-init)."""
+    from .ops import registry as _reg
+    _state.active = False
+    _reg.set_dispatch_cast_hook(None)
+
+
+# ---------------------------------------------------------------------------
+# loss scaling
+# ---------------------------------------------------------------------------
+
+class LossScaler:
+    """Dynamic loss scaler (reference contrib/amp/loss_scaler.py).
+
+    bf16 needs no scaling (f32 exponent range): ``loss_scale`` stays 1 and
+    ``has_overflow`` still guards against inf/nan grads (skip-step safety).
+    fp16 uses the reference dynamic algorithm: start high, halve on
+    overflow, double after ``scale_window`` clean steps.
+    """
+
+    def __init__(self, init_scale=None, scale_factor=2.0, scale_window=2000,
+                 target_dtype="float16"):
+        self._dynamic = str(target_dtype) == "float16"
+        if init_scale is None:
+            init_scale = 2.0 ** 16 if self._dynamic else 1.0
+        self.loss_scale = float(init_scale)
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._unskipped = 0
+
+    def has_overflow(self, grad_arrays):
+        """True if any gradient is non-finite; updates the dynamic scale.
+
+        One device sync total: the per-array non-finite counts accumulate
+        symbolically and a single bool() fetches the result."""
+        import jax.numpy as jnp
+        bad = None
+        for g in grad_arrays:
+            data = g._data if hasattr(g, "_data") else g
+            if not _is_float(data.dtype):
+                continue
+            n = jnp.logical_not(jnp.isfinite(data)).sum()
+            bad = n if bad is None else bad + n
+        finite = bad is None or not bool(bad > 0)
+        if not finite:
+            if self._dynamic:
+                self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+            return True
+        self._unskipped += 1
+        if self._dynamic and self._unskipped >= self._scale_window:
+            self.loss_scale *= self._scale_factor
+            self._unskipped = 0
+        return False
+
+
+def init_trainer(trainer):
+    """Attach a LossScaler to a gluon Trainer (reference amp.init_trainer)."""
+    if not _state.active:
+        raise MXNetError("call amp.init() before amp.init_trainer()")
+    tname = "bfloat16" if _state.target_dtype is not None and \
+        _np.dtype(_state.target_dtype).itemsize == 2 and \
+        "bfloat16" in str(_np.dtype(_state.target_dtype)) else "float16"
+    trainer._amp_loss_scaler = LossScaler(target_dtype=tname)
+    trainer._amp_original_scale = trainer._scale
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``.
+
+    Scales the loss up by the current loss scale and folds the inverse into
+    the trainer's gradient rescale so ``trainer.step`` sees unscaled
+    gradients (reference scale_loss flow).
+    """
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    trainer._amp_grads_unscaled = False  # new step: grads will carry the scale
+    if scaler is None or scaler.loss_scale == 1.0:
+        yield loss
+        return
+    s = scaler.loss_scale
+    trainer._scale = trainer._amp_original_scale / s
+    if isinstance(loss, (list, tuple)):
+        yield [l * s for l in loss]
+    else:
+        yield loss * s
+
+
+def unscale(trainer):
+    """Divide current gradients by the loss scale in place (reference
+    amp.unscale — for clipping between backward() and step())."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None or scaler.loss_scale == 1.0:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req == "null":
+            continue
+        for g in p.list_grad():
+            g *= inv
+    trainer._scale = trainer._amp_original_scale
+    trainer._amp_grads_unscaled = True  # step() must not divide again
+
+
+# ---------------------------------------------------------------------------
+# model conversion
+# ---------------------------------------------------------------------------
+
+_KEEP_FP32_PARAM_MARKERS = ("gamma", "beta", "running_mean", "running_var",
+                            "moving_mean", "moving_var")
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16",
+                         cast_optional_params=False):
+    """Cast a HybridBlock's parameters for low-precision inference
+    (reference amp.convert_hybrid_block over the nnvm ReducePrecision pass).
+
+    Matmul/conv weights go to ``target_dtype``; norm-layer statistics and
+    affine params stay float32 (the reference's fp32 list) unless
+    ``cast_optional_params``.  Dispatch-level casts from ``amp.init`` handle
+    activations; this handles the stored params.  Returns ``block``.
+    """
+    import ml_dtypes
+    tgt = ml_dtypes.bfloat16 if str(target_dtype) == "bfloat16" else _np.float16
+    for name, p in block.collect_params().items():
+        if p._data is None:
+            continue
+        if not cast_optional_params and any(
+                m in name for m in _KEEP_FP32_PARAM_MARKERS):
+            continue
+        if _is_float(p.dtype):
+            p.cast(tgt)
+    # rebuild any hybridize caches so the new dtypes retrace
+    for b in _iter_blocks(block):
+        if getattr(b, "_cached_op", None) is not None:
+            b._cached_op = None
+    return block
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  target_dtype_ops=None, fp32_ops=None,
+                  conditional_fp32_ops=None, excluded_sym_names=()):
+    """Symbolic-API conversion (reference amp.convert_model).
+
+    The graph itself needs no rewrite — executor dispatch applies the same
+    cast hook — so this casts the parameter dicts and returns
+    ``(sym, arg_params, aux_params)`` like the reference.
+    """
+    del target_dtype_ops, fp32_ops, conditional_fp32_ops  # hook-level already
+    import ml_dtypes
+    tgt = ml_dtypes.bfloat16 if str(target_dtype) == "bfloat16" else _np.float16
+    excluded = set(excluded_sym_names)
+
+    def conv(d):
+        out = {}
+        for k, v in d.items():
+            if k not in excluded and _is_float(v.dtype) and not any(
+                    m in k for m in _KEEP_FP32_PARAM_MARKERS):
+                out[k] = v.astype(tgt)
+            else:
+                out[k] = v
+        return out
+
+    return sym, conv(arg_params), conv(aux_params)
+
+
+def _iter_blocks(block):
+    yield block
+    for child in getattr(block, "_children", {}).values():
+        yield from _iter_blocks(child)

@@ -283,7 +283,8 @@ def _run_tape_backward(tape, create_graph=False):
         if create_graph:
             in_grads = _recorded_vjp_call(n)
         else:
-            cts = tuple(g if g is not None else _zeros_like_aval(av)
+            cts = tuple(_coerce_ct(g, av) if g is not None
+                        else _zeros_like_aval(av)
                         for g, av in zip(n.grads, n.out_avals))
             in_grads = n.vjp_fn(cts[0] if len(cts) == 1 else cts)
         for entry, g in zip(n.in_entries, in_grads):
@@ -309,6 +310,24 @@ def _run_tape_backward(tape, create_graph=False):
     return visited
 
 
+def _coerce_ct(g, aval):
+    """Cast a cotangent to its primal output's dtype.
+
+    Mixed-precision tapes (mx.amp) legitimately produce f32 cotangents for
+    bf16 primal outputs (downstream ops upcast); jax.vjp requires exact
+    dtype match, so coerce here — the reference's backward does the same
+    implicitly through amp_cast nodes in the grad graph."""
+    _, want_dtype = aval
+    data = g._data if hasattr(g, "_data") else g
+    if data.dtype != want_dtype:
+        cast = data.astype(want_dtype)
+        if hasattr(g, "_data"):
+            from .ndarray import ndarray as _nd
+            return _nd.NDArray._from_data(cast)
+        return cast
+    return g
+
+
 def _recorded_vjp_call(node):
     """create_graph=True: replay the op's vjp as a *recorded* op whose inputs
     are the original forward inputs plus the cotangents, so the backward ops
@@ -320,7 +339,7 @@ def _recorded_vjp_call(node):
     from .ndarray import ndarray as _nd
     import jax
 
-    cts = [g if g is not None else
+    cts = [_coerce_ct(g, av) if g is not None else
            _nd.NDArray._from_data(_zeros_like_aval(av))
            for g, av in zip(node.grads, node.out_avals)]
 

@@ -336,7 +336,8 @@ class CachedOp:
         ctx = next((a.ctx for a in input_nds), None)
         in_arrays = [a._data for a in input_nds]
         key = tuple((tuple(a.shape), str(a.dtype)) for a in in_arrays) \
-            + (train_mode, tuple(sorted(kwargs.items())))
+            + (train_mode, tuple(sorted(kwargs.items())),
+               _reg.dispatch_epoch())  # amp on/off ⇒ retrace with casts
         entry = self._cache.get(key)
         if entry is None:
             entry = self._trace(param_list, in_arrays, train_mode, kwargs)

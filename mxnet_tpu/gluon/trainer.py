@@ -15,6 +15,15 @@ runs one updater per ctx so replicas stay bit-identical.
 runs it on the PS server; here the store applies it to its canonical copy and
 ``pull`` broadcasts updated weights).  The fused SPMD alternative — whole
 train step jitted over a mesh — is mxnet_tpu.parallel.TrainStep.
+
+.. note:: **Documented divergence from the reference.** Upstream Trainer
+   defaults ``update_on_kvstore=True`` for ``local``/``device`` kvstores;
+   here it defaults to **False** (optimizer state stays on device, the best
+   placement on TPU where no server role exists).  Numerics are identical;
+   what differs is where ``save_states`` finds optimizer state and that
+   ``allreduce_grads()``/``update()`` are callable (they raise upstream when
+   the kvstore owns the update).  Pass ``update_on_kvstore=True`` explicitly
+   for reference-identical behavior.
 """
 
 from __future__ import annotations
@@ -123,12 +132,38 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """rescale by 1/batch_size, allreduce, update (reference flow)."""
+        """rescale by 1/batch_size, allreduce, update (reference flow).
+
+        With ``amp.init_trainer`` attached, the gradient rescale additionally
+        divides by the current loss scale (so updates see unscaled grads) and
+        non-finite gradients skip the update for this step while the dynamic
+        scaler backs off (reference amp trainer flow).
+        """
         self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        base_scale = getattr(self, "_amp_original_scale", self._scale)
+        scale = (base_scale if scaler is not None else self._scale) / batch_size
+        if scaler is not None:
+            if not getattr(self, "_amp_grads_unscaled", False):
+                # amp.unscale() already divided the grads in place — don't
+                # fold 1/loss_scale into the rescale a second time
+                scale /= scaler.loss_scale
+            self._amp_grads_unscaled = False
+            # overflow check BEFORE any update runs: with update_on_kvstore
+            # the store applies the optimizer inside _allreduce_grads, so a
+            # post-reduce check would be too late (inf in any replica makes
+            # the reduced grad inf, so pre-reduce detection is equivalent)
+            grads = [g for p in self._params if p.grad_req != "null"
+                     and p._data is not None for g in p.list_grad()]
+            if scaler.has_overflow(grads):
+                self._scale = base_scale
+                return  # skip step; dynamic scaler backed off
+        self._optimizer.rescale_grad = scale
         self._allreduce_grads()
         if not self._update_on_kvstore:
             self._update(ignore_stale_grad)
+        if scaler is not None:
+            self._scale = base_scale
 
     def allreduce_grads(self):
         self._init_kvstore()

@@ -1,0 +1,123 @@
+"""mx.monitor — per-op output statistics debugger.
+
+Reference: ``python/mxnet/monitor.py`` (P20) — ``Monitor(interval,
+stat_func, pattern, sort)`` hooks every executor's op outputs via
+``MXExecutorSetMonitorCallback`` and prints ``(batch, name, stat)`` rows.
+
+TPU-native design: there is no C executor to hook; the single imperative
+dispatch chokepoint (``ops.registry.invoke``) already sees every op's
+outputs on both the eager and symbol-executor paths, so ``Monitor`` plugs
+a stat callback there.  Stats are computed lazily as jax scalars and only
+fetched (device sync) at ``toc()`` — the reference likewise syncs when the
+user asks for stats.
+
+Note: inside a ``hybridize()``d block the interior ops run under one
+compiled XLA program and are not individually observable — same as the
+reference, where a fused/optimized graph hides interior nodes.  Call
+``net.hybridize(False)`` while monitoring.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+from .base import MXNetError
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(x):
+    import jax.numpy as jnp
+    return jnp.abs(x).mean()
+
+
+class Monitor:
+    """Collect op-output statistics every ``interval`` batches.
+
+    Parameters mirror the reference: ``stat_func(array) -> scalar array``
+    (default mean(|x|)), ``pattern`` regex over op/output names, ``sort``
+    orders results by name in ``toc()``.  Usage::
+
+        mon = mx.monitor.Monitor(interval=2)
+        mon.install()              # or mod.fit(..., monitor=mon)
+        mon.tic()
+        ... forward ...
+        for batch, name, stat in mon.toc():
+            print(batch, name, stat)
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        self.interval = int(interval)
+        self.stat_func = stat_func or _default_stat
+        self.re_pattern = re.compile(pattern)
+        self.sort = bool(sort)
+        self.step = 0
+        self.activated = False
+        self.queue = []
+        self._installed = False
+
+    # -- hook plumbing ------------------------------------------------------
+
+    def _hook(self, op_name, out_arrays):
+        if not self.activated:
+            return
+        import jax
+        for i, arr in enumerate(out_arrays):
+            if isinstance(arr, jax.core.Tracer):
+                continue  # interior op inside a jit trace — not observable
+            name = op_name if len(out_arrays) == 1 else f"{op_name}_output{i}"
+            if not self.re_pattern.match(name):
+                continue
+            try:
+                self.queue.append((self.step, name, self.stat_func(arr)))
+            except Exception:  # stat on non-numeric output — skip, as ref does
+                pass
+
+    def install(self, exe=None):  # noqa: ARG002 — executor arg kept for parity
+        """Start observing dispatch (reference: install on an executor;
+        here the dispatch ledger is global so one install covers all)."""
+        from .ops import registry as _reg
+        if not self._installed:
+            _reg.add_monitor_hook(self._hook)
+            self._installed = True
+        return self
+
+    def uninstall(self):
+        from .ops import registry as _reg
+        if self._installed:
+            _reg.remove_monitor_hook(self._hook)
+            self._installed = False
+
+    # -- reference API ------------------------------------------------------
+
+    def tic(self):
+        """Begin collecting for this batch if the interval hits."""
+        if not self._installed:
+            self.install()
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """End collection; returns list of (step, name, float stat)."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        for n, name, stat in self.queue:
+            try:
+                val = float(stat)
+            except (TypeError, ValueError) as e:
+                raise MXNetError(f"monitor stat for {name} not scalar: {e}") \
+                    from None
+            res.append((n, name, val))
+        self.queue = []
+        if self.sort:
+            res.sort(key=lambda t: t[1])
+        return res
+
+    def toc_print(self):
+        for n, name, val in self.toc():
+            logging.info("Batch: %7d %30s %s", n, name, val)

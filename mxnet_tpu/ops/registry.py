@@ -129,6 +129,46 @@ def list_ops():
 _jit_cache: dict = {}
 _jit_lock = threading.Lock()
 
+# Pre-dispatch array-cast hook (mxnet_tpu.amp): fn(op_name, arrays) -> arrays,
+# jax-traceable so it folds into jit traces.  _dispatch_epoch bumps whenever
+# the hook changes so shape/dtype-keyed caches (CachedOp) retrace.
+_cast_hook = None
+_dispatch_epoch = 0
+
+
+def set_dispatch_cast_hook(fn):
+    global _cast_hook, _dispatch_epoch
+    _cast_hook = fn
+    _dispatch_epoch += 1
+
+
+def dispatch_epoch():
+    return _dispatch_epoch
+
+
+def _apply_cast(op, arrays):
+    if _cast_hook is None:
+        return arrays
+    return _cast_hook(op.name, arrays)
+
+
+# Monitor hooks (mx.monitor): fn(op_name, out_arrays) called post-dispatch
+# with the op's raw output arrays.  Kept as a list so several monitors can
+# coexist (the reference allows one callback per executor; global here).
+_monitor_hooks: list = []
+
+
+def add_monitor_hook(fn):
+    if fn not in _monitor_hooks:
+        _monitor_hooks.append(fn)
+
+
+def remove_monitor_hook(fn):
+    try:
+        _monitor_hooks.remove(fn)
+    except ValueError:
+        pass
+
 
 def _freeze(v):
     if isinstance(v, (list, tuple)):
@@ -174,6 +214,7 @@ def _callable_for(op, attrs):
 
 def invoke_arrays(op, arrays, attrs):
     """Run an op on raw jax arrays (no NDArray wrapping, no tape)."""
+    arrays = _apply_cast(op, arrays)
     f = _callable_for(op, attrs)
     return f(*arrays)
 
@@ -227,6 +268,12 @@ def invoke(op, inputs, attrs=None, out=None, ctx=None):
         # capture residuals now; backward replays the stored closure only
         import jax
         f = _callable_for(op, attrs)
+        if _cast_hook is not None:
+            # amp casts must sit INSIDE the differentiated fn so vjp casts
+            # the input gradients back to the params' dtypes (the reference
+            # amp_cast op differentiates the same way)
+            def f(*arrs, _f=f, _name=op.name):
+                return _f(*_cast_hook(_name, list(arrs)))
         out_raw, vjp_fn = jax.vjp(f, *arrays)
     else:
         out_raw = invoke_arrays(op, arrays, attrs)
@@ -234,6 +281,9 @@ def invoke(op, inputs, attrs=None, out=None, ctx=None):
 
     out_arrays = _normalize_out(op, out_raw)
     engine.on_dispatch(out_arrays)
+    if _monitor_hooks:
+        for _h in _monitor_hooks:
+            _h(op.name, out_arrays)
 
     if _t0 is not None:
         import time as _time
