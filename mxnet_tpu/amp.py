@@ -250,9 +250,7 @@ def init_trainer(trainer):
     """Attach a LossScaler to a gluon Trainer (reference amp.init_trainer)."""
     if not _state.active:
         raise MXNetError("call amp.init() before amp.init_trainer()")
-    tname = "bfloat16" if _state.target_dtype is not None and \
-        _np.dtype(_state.target_dtype).itemsize == 2 and \
-        "bfloat16" in str(_np.dtype(_state.target_dtype)) else "float16"
+    tname = str(_np.dtype(_state.target_dtype))
     trainer._amp_loss_scaler = LossScaler(target_dtype=tname)
     trainer._amp_original_scale = trainer._scale
 

@@ -335,9 +335,13 @@ class CachedOp:
         # the trace itself is ctx-agnostic (same shapes) and shared
         ctx = next((a.ctx for a in input_nds), None)
         in_arrays = [a._data for a in input_nds]
+        # amp on/off bumps the dispatch epoch ⇒ drop stale traces (their
+        # cast decisions are baked in; keeping them would leak executables)
+        if getattr(self, "_cache_epoch", None) != _reg.dispatch_epoch():
+            self._cache.clear()
+            self._cache_epoch = _reg.dispatch_epoch()
         key = tuple((tuple(a.shape), str(a.dtype)) for a in in_arrays) \
-            + (train_mode, tuple(sorted(kwargs.items())),
-               _reg.dispatch_epoch())  # amp on/off ⇒ retrace with casts
+            + (train_mode, tuple(sorted(kwargs.items())))
         entry = self._cache.get(key)
         if entry is None:
             entry = self._trace(param_list, in_arrays, train_mode, kwargs)

@@ -1,0 +1,7 @@
+"""gluon.rnn — recurrent layers and cells (reference gluon/rnn/, P7)."""
+
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell,
+                       GRUCell, SequentialRNNCell, HybridSequentialRNNCell,
+                       DropoutCell, ZoneoutCell, ResidualCell,
+                       BidirectionalCell, ModifierCell)  # noqa: F401
+from .rnn_layer import RNN, LSTM, GRU  # noqa: F401

@@ -55,13 +55,20 @@ class BaseModule:
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
         validation_metric = validation_metric or eval_metric
+        if monitor is not None:
+            monitor.install()  # dispatch-level hook (reference installs per
+            # executor; our dispatch ledger is global — see mx.monitor)
 
         for epoch in range(begin_epoch, num_epoch):
             eval_metric.reset()
             train_data.reset()
             for nbatch, data_batch in enumerate(train_data):
+                if monitor is not None:
+                    monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                if monitor is not None:
+                    monitor.toc_print()
                 self.update_metric(eval_metric, data_batch.label)
                 if batch_end_callback is not None:
                     param = BatchEndParam(epoch=epoch, nbatch=nbatch,

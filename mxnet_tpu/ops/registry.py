@@ -267,7 +267,13 @@ def invoke(op, inputs, attrs=None, out=None, ctx=None):
     if recording:
         # capture residuals now; backward replays the stored closure only
         import jax
-        f = _callable_for(op, attrs)
+        f0 = _callable_for(op, attrs)
+
+        # canonicalize list outputs to tuples so backward's tuple cotangents
+        # match the vjp's output tree (multi-output ops may return lists)
+        def f(*arrs, _f=f0):
+            r = _f(*arrs)
+            return tuple(r) if isinstance(r, list) else r
         if _cast_hook is not None:
             # amp casts must sit INSIDE the differentiated fn so vjp casts
             # the input gradients back to the params' dtypes (the reference

@@ -336,6 +336,15 @@ class TrainStep:
         self._states = None       # index -> optimizer state (NDArray tree)
         self._state_nds = None    # flattened state NDArrays
         self._cache = {}
+        self._cache_epoch = None
+
+    def _evict_stale_traces(self):
+        """amp on/off bumps the dispatch epoch: traces baked pre-toggle cast
+        decisions, so running them would silently use the wrong precision."""
+        from .ops import registry as _reg
+        if self._cache_epoch != _reg.dispatch_epoch():
+            self._cache.clear()
+            self._cache_epoch = _reg.dispatch_epoch()
         self._step_count = 0
 
     # -- state plumbing -------------------------------------------------------
@@ -505,6 +514,7 @@ class TrainStep:
             probe = NDArray._from_data(data._data[0]) if stacked else data
             self._resolve(probe)
 
+        self._evict_stale_traces()
         key_sig = ("multi", stacked, steps,
                    (tuple(data.shape), str(data.dtype)),
                    (tuple(label.shape), str(label.dtype)))
@@ -558,6 +568,7 @@ class TrainStep:
         if self._params is None:
             self._resolve(data)
 
+        self._evict_stale_traces()
         key_sig = ((tuple(data.shape), str(data.dtype)),
                    (tuple(label.shape), str(label.dtype)))
         fn = self._cache.get(key_sig)

@@ -42,14 +42,16 @@ class Executor:
 
     def _get_build(self, is_train):
         from ..ops import registry as _reg
-        key = (is_train, _reg.dispatch_epoch())  # amp on/off ⇒ retrace
-        entry = self._builds.get(key)
+        if getattr(self, "_builds_epoch", None) != _reg.dispatch_epoch():
+            self._builds.clear()  # amp on/off ⇒ stale cast decisions
+            self._builds_epoch = _reg.dispatch_epoch()
+        entry = self._builds.get(is_train)
         if entry is None:
             import jax
             run, leaves, mut_specs = self._symbol._build_fn(
                 train_mode=is_train, collect_mutations=is_train)
             entry = (jax.jit(run), leaves, mut_specs)
-            self._builds[key] = entry
+            self._builds[is_train] = entry
         self._leaves = entry[1]
         return entry
 

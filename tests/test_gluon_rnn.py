@@ -1,0 +1,268 @@
+"""gluon.rnn tests — parity between fused layers and explicit cell math
+(reference tests/python/unittest/test_gluon_rnn.py patterns)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import rnn
+
+
+def _np_lstm_ref(x_seq, h0, c0, wi, wh, bi, bh):
+    """Numpy LSTM over time; gate order [i, f, g, o] (reference rnn-inl.h)."""
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+    H = h0.shape[-1]
+    h, c = h0, c0
+    outs = []
+    for t in range(x_seq.shape[0]):
+        g = x_seq[t] @ wi.T + bi + h @ wh.T + bh
+        i = sig(g[:, :H])
+        f = sig(g[:, H:2 * H])
+        gg = np.tanh(g[:, 2 * H:3 * H])
+        o = sig(g[:, 3 * H:])
+        c = f * c + i * gg
+        h = o * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs), h, c
+
+
+def _np_gru_ref(x_seq, h0, wi, wh, bi, bh):
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+    H = h0.shape[-1]
+    h = h0
+    outs = []
+    for t in range(x_seq.shape[0]):
+        xw = x_seq[t] @ wi.T + bi
+        hw = h @ wh.T + bh
+        r = sig(xw[:, :H] + hw[:, :H])
+        z = sig(xw[:, H:2 * H] + hw[:, H:2 * H])
+        n = np.tanh(xw[:, 2 * H:] + r * hw[:, 2 * H:])
+        h = (1 - z) * n + z * h
+        outs.append(h)
+    return np.stack(outs), h
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru"])
+def test_fused_layer_matches_numpy(mode, seeded):
+    T, N, I, H = 5, 3, 4, 6
+    r = np.random.RandomState(7)
+    x = r.randn(T, N, I).astype(np.float32)
+    layer = (rnn.LSTM if mode == "lstm" else rnn.GRU)(H, input_size=I)
+    layer.initialize(mx.initializer.Uniform(0.5))
+    out, states = layer(mx.nd.array(x), layer.begin_state(N))
+    p = {k.split("_", 1)[1]: v.data().asnumpy()
+         for k, v in layer.collect_params().items()}
+    wi, wh = p["l0_i2h_weight"], p["l0_h2h_weight"]
+    bi, bh = p["l0_i2h_bias"], p["l0_h2h_bias"]
+    h0 = np.zeros((N, H), np.float32)
+    if mode == "lstm":
+        ref, hT, cT = _np_lstm_ref(x, h0, h0.copy(), wi, wh, bi, bh)
+        np.testing.assert_allclose(states[1].asnumpy()[0], cT, rtol=2e-5,
+                                   atol=2e-5)
+    else:
+        ref, hT = _np_gru_ref(x, h0, wi, wh, bi, bh)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(states[0].asnumpy()[0], hT, rtol=2e-5,
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize("cls,mode", [(rnn.LSTMCell, "lstm"),
+                                      (rnn.GRUCell, "gru"),
+                                      (rnn.RNNCell, "rnn")])
+def test_cell_unroll_matches_fused_layer(cls, mode, seeded):
+    T, N, I, H = 4, 2, 3, 5
+    r = np.random.RandomState(3)
+    x = r.randn(N, T, I).astype(np.float32)
+    cell = cls(H, input_size=I)
+    cell.initialize(mx.initializer.Uniform(0.5))
+    outs, _ = cell.unroll(T, mx.nd.array(x), layout="NTC")
+
+    layer_cls = {"lstm": rnn.LSTM, "gru": rnn.GRU}.get(mode)
+    if layer_cls is None:
+        layer = rnn.RNN(H, activation="tanh", input_size=I, layout="NTC")
+    else:
+        layer = layer_cls(H, input_size=I, layout="NTC")
+    layer.initialize()
+    layer(mx.nd.array(x))  # materialize params
+    # copy cell params into the fused layer
+    cp = cell.collect_params()
+    lp = layer.collect_params()
+    for short in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+        src = next(v for k, v in cp.items() if k.endswith(short))
+        dst = next(v for k, v in lp.items() if k.endswith(f"l0_{short}"))
+        dst.set_data(src.data())
+    fused = layer(mx.nd.array(x))
+    np.testing.assert_allclose(outs.asnumpy(), fused.asnumpy(), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_rnn_layer_hybridize_parity(seeded):
+    T, N, I, H = 6, 4, 5, 7
+    x = mx.nd.array(np.random.RandomState(0).randn(T, N, I)
+                    .astype(np.float32))
+    layer = rnn.LSTM(H, num_layers=2, input_size=I)
+    layer.initialize(mx.initializer.Xavier())
+    imp = layer(x).asnumpy()
+    layer.hybridize()
+    hyb = layer(x).asnumpy()
+    np.testing.assert_allclose(imp, hyb, rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_layer_grad_flows(seeded):
+    layer = rnn.GRU(4, num_layers=1, input_size=3)
+    layer.initialize()
+    x = mx.nd.array(np.random.RandomState(1).randn(5, 2, 3)
+                    .astype(np.float32))
+    with autograd.record():
+        out = layer(x)
+        loss = (out ** 2).sum()
+    loss.backward()
+    for name, p in layer.collect_params().items():
+        g = p.list_grad()[0].asnumpy()
+        assert np.isfinite(g).all(), name
+        assert np.abs(g).sum() > 0, f"zero grad for {name}"
+
+
+def test_bidirectional_layer_shapes():
+    layer = rnn.LSTM(8, num_layers=2, bidirectional=True, input_size=5)
+    layer.initialize()
+    x = mx.nd.ones((7, 3, 5))
+    out, states = layer(x, layer.begin_state(3))
+    assert out.shape == (7, 3, 16)
+    assert states[0].shape == (4, 3, 8)  # layers*dirs
+    assert states[1].shape == (4, 3, 8)
+
+
+def test_bidirectional_cell_unroll(seeded):
+    l_cell = rnn.LSTMCell(4, input_size=3)
+    r_cell = rnn.LSTMCell(4, input_size=3)
+    bi = rnn.BidirectionalCell(l_cell, r_cell)
+    bi.initialize()
+    x = mx.nd.ones((2, 5, 3))
+    outs, states = bi.unroll(5, x, layout="NTC")
+    assert outs.shape == (2, 5, 8)
+    assert len(states) == 4
+
+
+def test_bidirectional_unroll_valid_length(seeded):
+    # reverse direction must see each sample's VALID portion front-aligned:
+    # a short sample unrolled alone must match its slice of the batch
+    I, H = 3, 4
+    l_cell = rnn.LSTMCell(H, input_size=I)
+    r_cell = rnn.LSTMCell(H, input_size=I)
+    bi = rnn.BidirectionalCell(l_cell, r_cell)
+    bi.initialize(mx.initializer.Uniform(0.4))
+    r = np.random.RandomState(2)
+    x = r.randn(2, 4, I).astype(np.float32)
+    vl = mx.nd.array(np.array([2, 4], np.float32))
+    outs, _ = bi.unroll(4, mx.nd.array(x), layout="NTC", valid_length=vl,
+                        merge_outputs=True)
+    solo, _ = bi.unroll(2, mx.nd.array(x[:1, :2]), layout="NTC",
+                        merge_outputs=True)
+    np.testing.assert_allclose(outs.asnumpy()[0, :2], solo.asnumpy()[0],
+                               rtol=2e-5, atol=2e-5)
+    assert np.allclose(outs.asnumpy()[0, 2:], 0.0)  # masked tail
+
+
+def test_sequential_cell_stack(seeded):
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(6, input_size=4))
+    stack.add(rnn.DropoutCell(0.0))
+    stack.add(rnn.GRUCell(3, input_size=6))
+    stack.initialize()
+    x = mx.nd.ones((2, 4))
+    states = stack.begin_state(2)
+    assert len(states) == 3  # 2 (lstm) + 0 (dropout) + 1 (gru)
+    out, new_states = stack(x, states)
+    assert out.shape == (2, 3)
+    assert len(new_states) == 3
+    outs, _ = stack.unroll(4, mx.nd.ones((2, 4, 4)), layout="NTC")
+    assert outs.shape == (2, 4, 3)
+
+
+def test_residual_and_zoneout_cells(seeded):
+    base = rnn.GRUCell(4, input_size=4)
+    res = rnn.ResidualCell(base)
+    res.initialize()
+    x = mx.nd.ones((2, 4))
+    st = res.begin_state(2)
+    out, _ = res(x, st)
+    inner, _ = base(x, st)
+    np.testing.assert_allclose(out.asnumpy(),
+                               (inner + x).asnumpy(), rtol=1e-6)
+
+    z = rnn.ZoneoutCell(rnn.LSTMCell(4, input_size=4), 0.5, 0.5)
+    z.initialize()
+    out, states = z(mx.nd.ones((2, 4)), z.begin_state(2))
+    assert out.shape == (2, 4)  # inference: no zoneout applied
+    with autograd.record():
+        out2, _ = z(mx.nd.ones((2, 4)), z.begin_state(2))
+    assert out2.shape == (2, 4)
+
+
+def test_unroll_valid_length(seeded):
+    cell = rnn.RNNCell(3, input_size=2)
+    cell.initialize()
+    x = mx.nd.ones((2, 4, 2))
+    vl = mx.nd.array(np.array([2, 4], np.float32))
+    outs, states = cell.unroll(4, x, layout="NTC", valid_length=vl,
+                               merge_outputs=True)
+    o = outs.asnumpy()
+    assert np.allclose(o[0, 2:], 0.0)  # masked beyond valid length
+    assert not np.allclose(o[1, 3], 0.0)
+    # states are from the last valid step
+    full, all_st = cell.unroll(2, x[:, :2], layout="NTC")
+    np.testing.assert_allclose(states[0].asnumpy()[0],
+                               all_st[0].asnumpy()[0], rtol=1e-5)
+
+
+def test_unfuse_matches_layer(seeded):
+    layer = rnn.LSTM(5, num_layers=2, input_size=4)
+    layer.initialize(mx.initializer.Uniform(0.3))
+    x = mx.nd.array(np.random.RandomState(5).randn(6, 2, 4)
+                    .astype(np.float32))
+    fused = layer(x)
+    stack = layer._unfuse()
+    stack.initialize()
+    # copy weights layer by layer
+    lp = layer.collect_params()
+    sp = stack.collect_params()
+    for k, dst in sp.items():
+        tail = "_".join(k.rsplit("_")[-3:])  # e.g. l0_i2h_weight ... match by suffix
+        src = next(v for kk, v in lp.items() if kk.endswith(tail))
+        if dst.shape != src.shape:
+            dst.shape_mismatch_update(src.shape)
+        dst.set_data(src.data())
+    outs, _ = stack.unroll(6, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(fused.asnumpy(), outs.asnumpy(), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_rnn_layer_in_training_loop(seeded):
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        pass
+    layer = rnn.LSTM(16, input_size=8, layout="NTC")
+    dense = gluon.nn.Dense(2)
+    layer.initialize()
+    dense.initialize()
+    params = gluon.ParameterDict()
+    params.update(layer.collect_params())
+    params.update(dense.collect_params())
+    tr = gluon.Trainer(params, "adam", {"learning_rate": 1e-2})
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    r = np.random.RandomState(0)
+    x = mx.nd.array(r.randn(8, 5, 8).astype(np.float32))
+    y = mx.nd.array(r.randint(0, 2, (8,)))
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            h = layer(x)
+            loss = lossf(dense(h[:, -1]), y)
+        loss.backward()
+        tr.step(8)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < losses[0]
