@@ -20,8 +20,10 @@ infra errors — the axon remote-compile tunnel can flake, and a crashed bench
 records nothing.
 
 Env knobs:
-  MXNET_BENCH_MODEL       bert_12_768_12 (default) | bert_6_512_8 | bert_3_128_2
-  MXNET_BENCH_BATCH       default 128
+  MXNET_BENCH_MODEL       bert_12_768_12 (default) | bert_6_512_8 |
+                          bert_3_128_2 | any model_zoo.vision name
+                          (resnet50_v1 → the BASELINE images/sec lane)
+  MXNET_BENCH_BATCH       default 128 (BERT) / 64 (vision)
   MXNET_BENCH_SEQLEN      default 128
   MXNET_BENCH_DTYPE       bfloat16 (default) | float32
   MXNET_BENCH_SCAN_STEPS  steps fused per dispatch, default 16
@@ -51,6 +53,67 @@ def _peak_flops(dtype):
     else:  # v5e / "TPU v5 lite"
         bf16_peak = 197e12
     return bf16_peak if dtype == "bfloat16" else bf16_peak / 4
+
+
+def run_vision_once(name, batch, dtype, scan_steps, dispatches):
+    """Secondary lane (BASELINE config 2): vision-zoo train step, images/sec.
+
+    vs_baseline compares against the reference's era-typical 1xV100 fp32
+    ResNet-50 number (~400 img/s, BASELINE.md — UNVERIFIED, indicative)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.gluon.model_zoo import get_model
+
+    size = 299 if "inception" in name else 224
+    classes = 1000
+    mx.random.seed(0)
+    np.random.seed(0)
+    model = get_model(name, classes=classes)
+    model.initialize(mx.initializer.Xavier())
+    img_dt = np.float32
+    if dtype == "bfloat16":
+        import jax
+        jax.config.update("jax_default_matmul_precision", "default")
+        import ml_dtypes
+        model.cast(ml_dtypes.bfloat16)
+        img_dt = ml_dtypes.bfloat16
+
+    def loss_fn(out, labels):
+        return mx.nd.softmax_cross_entropy(
+            out.astype("float32"), labels.reshape((-1,))) / labels.size
+
+    mesh = parallel.make_mesh()
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=(dtype == "bfloat16"))
+    step = parallel.TrainStep(model, loss_fn, opt, mesh=mesh)
+
+    # one on-device batch scanned scan_steps times per dispatch: synthetic
+    # data must not meter host->device bandwidth (a 224x224 batch is ~10MB;
+    # the token-based BERT lane ships ~KBs) — the input pipeline is measured
+    # separately by the io benchmarks, as in the reference perf.md tables
+    r = np.random.RandomState(0)
+    imgs = nd.array(r.randn(batch, 3, size, size).astype(img_dt))
+    labs = nd.array(r.randint(0, classes, (batch,)).astype(np.int32))
+
+    losses = step.run(imgs, labs, steps=scan_steps)
+    float(np.asarray(losses.asnumpy()[-1]))
+
+    t0 = time.perf_counter()
+    for _ in range(dispatches):
+        losses = step.run(imgs, labs, steps=scan_steps)
+    last_loss = float(np.asarray(losses.asnumpy()[-1], np.float64))
+    dt = time.perf_counter() - t0
+    n_steps = scan_steps * dispatches
+    images_per_sec = batch * n_steps / dt
+    return {
+        "metric": f"{name}_train_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/s",
+        "vs_baseline": round(images_per_sec / 400.0, 4),
+        "extra": {"dtype": dtype, "batch": batch, "size": size,
+                  "step_ms": round(1000 * dt / n_steps, 2),
+                  "loss": last_loss},
+    }
 
 
 def run_once(name, batch, seq_len, dtype, scan_steps, dispatches):
@@ -138,13 +201,26 @@ def main():
     scan_steps = int(os.environ.get("MXNET_BENCH_SCAN_STEPS", "16"))
     dispatches = int(os.environ.get("MXNET_BENCH_DISPATCHES", "2"))
 
+    vision = not name.startswith("bert")
+    if vision:
+        if "MXNET_BENCH_BATCH" not in os.environ:
+            batch = 64
+        if "MXNET_BENCH_SCAN_STEPS" not in os.environ:
+            scan_steps = 64  # amortize per-dispatch tunnel overhead
+
     # (batch, note) ladder: same config twice (transient tunnel flakes),
     # then halved batch (memory/oversize fallback)
-    attempts = [(batch, None), (batch, "retry"), (max(batch // 2, 1), "half-batch")]
+    attempts = [(batch, None), (batch, "retry"),
+                (max(batch // 2, 1), "half-batch")]
     last_err = None
     for i, (b, note) in enumerate(attempts):
         try:
-            result = run_once(name, b, seq_len, dtype, scan_steps, dispatches)
+            if vision:
+                result = run_vision_once(name, b, dtype, scan_steps,
+                                         dispatches)
+            else:
+                result = run_once(name, b, seq_len, dtype, scan_steps,
+                                  dispatches)
             if note:
                 result["extra"]["note"] = note
             print(json.dumps(result))
@@ -154,9 +230,10 @@ def main():
             traceback.print_exc(file=sys.stderr)
             if i + 1 < len(attempts):
                 time.sleep(5 * (i + 1))
+    kind = "images" if vision else "samples"
     print(json.dumps({
-        "metric": f"{name}_train_samples_per_sec_per_chip",
-        "value": 0.0, "unit": "samples/s", "vs_baseline": 0.0,
+        "metric": f"{name}_train_{kind}_per_sec_per_chip",
+        "value": 0.0, "unit": f"{kind}/s", "vs_baseline": 0.0,
         "extra": {"error": f"{type(last_err).__name__}: {last_err}"[:300]},
     }))
     return 1

@@ -473,7 +473,7 @@ class BidirectionalCell(RecurrentCell):
         r_out, r_states = r_cell.unroll(
             length, r_seq, states[nl:],
             layout="NTC" if axis == 1 else layout, merge_outputs=False,
-            valid_length=None if valid_length is None else valid_length)
+            valid_length=valid_length)
         if valid_length is None:
             r_out = list(reversed(r_out))
         else:

@@ -326,18 +326,27 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     red = tuple(i for i in range(data.ndim) if i != axis)
     shape = [1] * data.ndim
     shape[axis] = -1
+    # normalize in float32 but return the INPUT dtype (cuDNN BN contract:
+    # low-precision data + fp32 stats, reference cudnn_batch_norm.cc) —
+    # mixed bf16-data/f32-gamma networks stay bf16 end to end
+    in_dtype = data.dtype
+    # upcast only narrower-than-f32 dtypes; f32/f64 keep full precision
+    compute = jnp.float32 if in_dtype.itemsize < 4 else in_dtype
+    xf = data.astype(compute)
     if _training and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
-        new_mm = moving_mean * momentum + mean * (1 - momentum)
-        new_mv = moving_var * momentum + var * (1 - momentum)
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.var(xf, axis=red)
+        one_m = jnp.asarray(1 - momentum, moving_mean.dtype)
+        new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) * one_m
+        new_mv = moving_var * momentum + var.astype(moving_var.dtype) * one_m
     else:
-        mean, var = moving_mean, moving_var
+        mean, var = moving_mean.astype(compute), moving_var.astype(compute)
         new_mm, new_mv = moving_mean, moving_var
-    inv = jnp.asarray(1.0, data.dtype) / jnp.sqrt(var + eps)
-    out = (data - mean.reshape(shape)) * inv.reshape(shape) * g.reshape(shape) \
-        + beta.reshape(shape)
-    return out, new_mm, new_mv
+    inv = 1.0 / jnp.sqrt(var + eps)
+    out = (xf - mean.reshape(shape)) * inv.reshape(shape) \
+        * g.astype(compute).reshape(shape) \
+        + beta.astype(compute).reshape(shape)
+    return out.astype(in_dtype), new_mm, new_mv
 
 
 @register("LayerNorm")

@@ -337,6 +337,7 @@ class TrainStep:
         self._state_nds = None    # flattened state NDArrays
         self._cache = {}
         self._cache_epoch = None
+        self._step_count = 0
 
     def _evict_stale_traces(self):
         """amp on/off bumps the dispatch epoch: traces baked pre-toggle cast
@@ -345,7 +346,6 @@ class TrainStep:
         if self._cache_epoch != _reg.dispatch_epoch():
             self._cache.clear()
             self._cache_epoch = _reg.dispatch_epoch()
-        self._step_count = 0
 
     # -- state plumbing -------------------------------------------------------
     @staticmethod

@@ -242,9 +242,6 @@ def test_unfuse_matches_layer(seeded):
 
 
 def test_rnn_layer_in_training_loop(seeded):
-    net = gluon.nn.HybridSequential()
-    with net.name_scope():
-        pass
     layer = rnn.LSTM(16, input_size=8, layout="NTC")
     dense = gluon.nn.Dense(2)
     layer.initialize()

@@ -1,0 +1,84 @@
+"""model_zoo.vision tests (reference test_gluon_model_zoo.py patterns).
+
+Forward passes use small inputs / small nets to keep the CPU-platform
+suite fast; every zoo name must at least construct and hold the right
+classifier shape.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo import get_model, vision
+
+
+ALL_MODELS = sorted(vision._models)
+
+
+def test_all_names_construct():
+    for name in ALL_MODELS:
+        net = get_model(name, classes=7)
+        assert net is not None, name
+
+
+def test_unknown_name_raises():
+    with pytest.raises(MXNetError, match="not in the model zoo"):
+        get_model("resnet1999_v9")
+
+
+def test_pretrained_raises():
+    with pytest.raises(MXNetError, match="pretrained"):
+        get_model("resnet18_v1", pretrained=True)
+
+
+@pytest.mark.parametrize("name", ["resnet18_v1", "resnet18_v2",
+                                  "mobilenet0.25", "squeezenet1.1"])
+def test_small_models_forward(name, seeded):
+    net = get_model(name, classes=10)
+    net.initialize()
+    out = net(mx.nd.ones((2, 3, 32, 32)))
+    assert out.shape == (2, 10)
+
+
+def test_resnet_thumbnail_trains(seeded):
+    # CIFAR-style lane: thumbnail avoids the 7x7/maxpool stem
+    net = vision.resnet18_v1(classes=4, thumbnail=True)
+    net.initialize(mx.initializer.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    r = np.random.RandomState(0)
+    x = mx.nd.array(r.randn(8, 3, 16, 16).astype(np.float32))
+    y = mx.nd.array(r.randint(0, 4, (8,)))
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            loss = lossf(net(x), y)
+        loss.backward()
+        tr.step(8)
+        losses.append(float(loss.mean().asnumpy()))
+    assert min(losses[1:]) < losses[0]  # optimizing (BN+adam jitter allowed)
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_resnet50_structure():
+    net = vision.resnet50_v1(classes=11)
+    params = net.collect_params()
+    keys = list(params.keys())
+    # bottleneck stages: 3+4+6+3 blocks, each 3 convs + stem + downsamples
+    n_convs = sum(1 for k in keys if "conv" in k and k.endswith("weight"))
+    assert n_convs == 1 + (3 + 4 + 6 + 3) * 3 + 4  # stem + body + downsample
+    dense_w = next(k for k in keys if "dense" in k and k.endswith("weight"))
+    assert params[dense_w].shape[0] == 11
+
+
+def test_hybridize_parity_resnet(seeded):
+    net = vision.resnet18_v1(classes=5, thumbnail=True)
+    net.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(np.random.RandomState(1).randn(2, 3, 16, 16)
+                    .astype(np.float32))
+    imp = net(x).asnumpy()
+    net.hybridize()
+    hyb = net(x).asnumpy()
+    np.testing.assert_allclose(imp, hyb, rtol=1e-4, atol=1e-5)
