@@ -68,7 +68,14 @@ class Context:
         """The concrete jax.Device this context denotes (resolved lazily)."""
         jax = _jax()
         if self._platform() == "cpu":
-            devs = jax.devices("cpu")
+            # LOCAL devices only: in multi-process (jax.distributed) runs a
+            # context always denotes this process's own devices, like the
+            # reference's per-worker ctx (global jax.devices() would hand
+            # rank>0 processes an unaddressable device)
+            try:
+                devs = jax.local_devices(backend="cpu")
+            except RuntimeError:  # no cpu backend registered (rare)
+                devs = [d for d in jax.devices() if d.platform == "cpu"]
         else:
             devs = _accelerator_devices()
             if not devs:
@@ -119,11 +126,13 @@ class Context:
 
 def _accelerator_devices():
     jax = _jax()
-    devs = []
-    for d in jax.devices():
-        if d.platform != "cpu":
-            devs.append(d)
-    return devs
+    try:
+        devs = [d for d in jax.local_devices() if d.platform != "cpu"]
+    except RuntimeError:
+        devs = []
+    if devs:
+        return devs
+    return [d for d in jax.devices() if d.platform != "cpu"]
 
 
 def cpu(device_id=0):

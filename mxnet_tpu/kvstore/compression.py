@@ -1,0 +1,99 @@
+"""2-bit gradient compression with error feedback (reference
+src/kvstore/gradient_compression.{cc,cu,h}, N16).
+
+Reference algorithm (GradientCompression type '2bit'): with threshold t,
+each element of (grad + residual) maps to one of {+t, 0, -t}; the 2-bit
+codes pack 16-to-a-float32 on the wire; the residual keeps what
+quantization dropped (error feedback) so the signal is unbiased over
+steps.
+
+TPU-native shape: pack/unpack are jit-able jnp functions (4 codes per
+uint8 lane — VPU-friendly bitops, no Python loops), so they fuse into the
+push path.  Over the wire (dist_tpu_sync) the packed uint8 buffer is what
+crosses DCN — 16x smaller than f32; each receiver dequantizes and sums.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..base import MXNetError
+
+__all__ = ["GradientCompression"]
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("threshold",))
+def _quantize_2bit(grad, residual, threshold):
+    """Returns (packed uint8 codes, new_residual).
+
+    code 0 → 0.0, 1 → +threshold, 2 → -threshold (reference encoding).
+    """
+    import jax.numpy as jnp
+    g = grad + residual
+    t = jnp.asarray(threshold, grad.dtype)
+    q = jnp.where(g >= t, t, jnp.where(g <= -t, -t, jnp.asarray(0, grad.dtype)))
+    new_residual = g - q
+    codes = jnp.where(g >= t, 1, jnp.where(g <= -t, 2, 0)).astype(jnp.uint8)
+    flat = codes.reshape(-1)
+    pad = (-flat.size) % 4
+    flat = jnp.pad(flat, (0, pad))
+    c = flat.reshape(-1, 4)
+    packed = (c[:, 0] | (c[:, 1] << 2) | (c[:, 2] << 4)
+              | (c[:, 3] << 6)).astype(jnp.uint8)
+    return packed, new_residual
+
+
+@functools.partial(__import__("jax").jit,
+                   static_argnames=("threshold", "shape", "dtype"))
+def _dequantize_2bit(packed, threshold, shape, dtype):
+    import jax.numpy as jnp
+    import numpy as np
+    n = int(np.prod(shape)) if shape else 1
+    c = packed[:, None] >> jnp.asarray([0, 2, 4, 6], jnp.uint8)[None, :]
+    codes = (c & 0x3).reshape(-1)[:n]
+    t = jnp.asarray(threshold, dtype)
+    vals = jnp.where(codes == 1, t, jnp.where(codes == 2, -t,
+                                              jnp.asarray(0, dtype)))
+    return vals.reshape(shape)
+
+
+class GradientCompression:
+    """Per-key compressor state (reference GradientCompression).
+
+    ``compress(key, slot, grad)`` quantizes grad (+ the running residual
+    for (key, slot)) and returns the packed codes; ``decompress`` restores
+    dense values.  One residual per (key, device-slot), as the reference
+    keeps one per worker.
+    """
+
+    def __init__(self, params):
+        params = dict(params or {})
+        ctype = params.pop("type", params.pop("compression", "2bit"))
+        if ctype != "2bit":
+            raise MXNetError(
+                f"unsupported gradient compression type {ctype!r}: the "
+                "reference implements only '2bit' "
+                "(src/kvstore/gradient_compression.cc)")
+        self.type = ctype
+        self.threshold = float(params.pop("threshold", 0.5))
+        if self.threshold <= 0:
+            raise MXNetError("gradient compression threshold must be > 0")
+        if params:
+            raise MXNetError(f"unknown compression params {sorted(params)}")
+        self._residuals = {}
+
+    def compress(self, key, slot, grad_data):
+        """grad_data: raw jax array → (packed uint8, shape, dtype)."""
+        import jax.numpy as jnp
+        rkey = (key, slot)
+        res = self._residuals.get(rkey)
+        if res is None:
+            res = jnp.zeros_like(grad_data)
+        packed, new_res = _quantize_2bit(grad_data, res, self.threshold)
+        self._residuals[rkey] = new_res
+        return packed, grad_data.shape, grad_data.dtype
+
+    def decompress(self, packed, shape, dtype):
+        import numpy as np
+        return _dequantize_2bit(packed, self.threshold, tuple(shape),
+                                np.dtype(dtype).name)

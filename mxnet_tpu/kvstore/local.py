@@ -93,7 +93,11 @@ class KVStoreLocal(KVStoreBase):
             key = key[0]
         if key not in self._store:
             raise MXNetError(f"key {key!r} not initialized")
-        merged = self._reduce(value)
+        merged = self._reduce(self._compress_values(key, value))
+        self._store_merged(key, merged)
+
+    def _store_merged(self, key, merged):
+        """Post-reduction store/update step (shared with the dist store)."""
         if self._updater is not None:
             self._updater(key, merged, self._store[key])
         else:
@@ -160,7 +164,30 @@ class KVStoreLocal(KVStoreBase):
         self._updater = updater
 
     def set_gradient_compression(self, compression_params):
-        self._compression = dict(compression_params)
+        """2-bit + error-feedback compression on pushed gradients
+        (reference set_gradient_compression / gradient_compression.cc).
+        Each replica's contribution is quantized to {-t, 0, +t} (residual
+        carried per (key, replica)) before the reduction — the same
+        worker-side quantization the reference applies before transmitting
+        to the PS."""
+        from .compression import GradientCompression
+        self._compression = GradientCompression(compression_params)
+
+    def _compress_values(self, key, values):
+        """Quantize→dequantize each replica's dense contribution."""
+        if self._compression is None:
+            return values
+        vlist = values if _is_list(values) else [values]
+        if any(isinstance(v, sp.BaseSparseNDArray) for v in vlist):
+            return values  # reference compresses dense grads only
+        out = []
+        for slot, v in enumerate(vlist):
+            packed, shape, dtype = self._compression.compress(
+                key, slot, v._data)
+            out.append(NDArray._from_data(
+                self._compression.decompress(packed, shape, dtype),
+                ctx=v.ctx))
+        return out if _is_list(values) else out[0]
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:

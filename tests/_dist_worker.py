@@ -1,0 +1,86 @@
+"""Worker body for the 2-process dist_tpu_sync test (run via
+tools/launch.py; mirrors tests/nightly/dist_sync_kvstore.py exact-value
+checks).  Not collected by pytest (no test_ prefix)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# the axon PJRT plugin overrides the JAX_PLATFORMS env var, so pin the
+# platform through jax.config (same trick as tests/conftest.py)
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+# distributed init MUST precede backend init (jax.distributed contract)
+jax.distributed.initialize(
+    coordinator_address=os.environ["MXNET_DIST_COORDINATOR"],
+    num_processes=int(os.environ["MXNET_DIST_NUM_WORKERS"]),
+    process_id=int(os.environ["MXNET_DIST_RANK"]))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    kv = mx.kv.create("dist_tpu_sync")
+    assert kv.num_workers == 2, kv.num_workers
+    rank = kv.rank
+    shape = (3, 4)
+
+    # 1. exact-value dense allreduce: each worker pushes rank+1 everywhere
+    kv.init(3, mx.nd.zeros(shape))
+    kv.push(3, mx.nd.array(np.full(shape, rank + 1.0, np.float32)))
+    out = mx.nd.zeros(shape)
+    kv.pull(3, out)
+    np.testing.assert_allclose(out.asnumpy(), 3.0)  # 1 + 2
+
+    # 2. second round with different values (checks no stale state)
+    kv.push(3, mx.nd.array(np.full(shape, (rank + 1) * 10.0, np.float32)))
+    kv.pull(3, out)
+    np.testing.assert_allclose(out.asnumpy(), 30.0)
+
+    # 3. rank-dependent structured values: position (i, j) gets
+    #    sum_r (r + i + j) = (0 + i+j) + (1 + i+j)
+    base = np.add.outer(np.arange(3), np.arange(4)).astype(np.float32)
+    kv.push(3, mx.nd.array(base + rank))
+    kv.pull(3, out)
+    np.testing.assert_allclose(out.asnumpy(), 2 * base + 1.0)
+
+    # 4. barrier + multi-key list API
+    kv.barrier()
+    kv.init([5, 7], [mx.nd.zeros((2,)), mx.nd.zeros((2,))])
+    kv.push([5, 7], [mx.nd.ones((2,)) * (rank + 1),
+                     mx.nd.ones((2,)) * (rank + 5)])
+    outs = [mx.nd.zeros((2,)), mx.nd.zeros((2,))]
+    kv.pull([5, 7], outs)
+    np.testing.assert_allclose(outs[0].asnumpy(), 3.0)
+    np.testing.assert_allclose(outs[1].asnumpy(), 11.0)  # 6 + 5
+
+    # 5. 2-bit compression over the wire (packed allgather path):
+    #    rank0 pushes +0.7 (→ +t), rank1 pushes -0.6 (→ -t); sum == 0;
+    #    second round consumes the residuals (0.2, -0.1): 0.2+0.4 → +t,
+    #    -0.1-0.3 < -t/…? -0.4 → 0  ⇒ sum == +t
+    kv2 = mx.kv.create("dist_tpu_sync")
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    shape2 = (2, 3)
+    kv2.init(11, mx.nd.zeros(shape2))
+    first = 0.7 if rank == 0 else -0.6
+    kv2.push(11, mx.nd.array(np.full(shape2, first, np.float32)))
+    out2 = mx.nd.zeros(shape2)
+    kv2.pull(11, out2)
+    np.testing.assert_allclose(out2.asnumpy(), 0.0)
+    second = 0.4 if rank == 0 else -0.3
+    kv2.push(11, mx.nd.array(np.full(shape2, second, np.float32)))
+    kv2.pull(11, out2)
+    np.testing.assert_allclose(out2.asnumpy(), 0.5)
+
+    print(f"worker {rank}: OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

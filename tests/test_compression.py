@@ -1,0 +1,97 @@
+"""2-bit gradient compression tests (reference
+tests/nightly/dist_sync_kvstore.py :: test_sync_2bit_compression — exact
+expected values, plus pack/unpack round-trips)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore.compression import GradientCompression
+
+
+def test_quantize_roundtrip_exact():
+    gc = GradientCompression({"type": "2bit", "threshold": 0.5})
+    grad = mx.nd.array(np.array([0.7, -0.6, 0.1, -0.1, 0.5, -0.5, 0.0],
+                                np.float32))
+    packed, shape, dtype = gc.compress("k", 0, grad._data)
+    assert str(np.asarray(packed).dtype) == "uint8"
+    assert packed.size == 2  # ceil(7/4) bytes — 16x smaller than f32
+    out = np.asarray(gc.decompress(packed, shape, dtype))
+    np.testing.assert_allclose(
+        out, [0.5, -0.5, 0.0, 0.0, 0.5, -0.5, 0.0])
+
+
+def test_error_feedback_accumulates():
+    # 0.3 < threshold: quantizes to 0, residual 0.3; next push 0.3+0.3=0.6
+    # crosses the threshold → +t, residual 0.1
+    gc = GradientCompression({"type": "2bit", "threshold": 0.5})
+    g = mx.nd.array(np.full((4,), 0.3, np.float32))
+    p1, shape, dtype = gc.compress("k", 0, g._data)
+    np.testing.assert_allclose(np.asarray(gc.decompress(p1, shape, dtype)),
+                               0.0)
+    p2, _, _ = gc.compress("k", 0, g._data)
+    np.testing.assert_allclose(np.asarray(gc.decompress(p2, shape, dtype)),
+                               0.5)
+    res = np.asarray(gc._residuals[("k", 0)])
+    np.testing.assert_allclose(res, 0.1, rtol=1e-6)
+
+
+def test_residuals_per_key_and_slot():
+    gc = GradientCompression({"threshold": 1.0})
+    a = mx.nd.array(np.array([0.4], np.float32))
+    gc.compress("k1", 0, a._data)
+    gc.compress("k1", 1, a._data)
+    gc.compress("k2", 0, a._data)
+    assert set(gc._residuals) == {("k1", 0), ("k1", 1), ("k2", 0)}
+
+
+def test_invalid_params_raise():
+    with pytest.raises(MXNetError, match="only '2bit'"):
+        GradientCompression({"type": "1bit"})
+    with pytest.raises(MXNetError, match="threshold"):
+        GradientCompression({"type": "2bit", "threshold": 0})
+    with pytest.raises(MXNetError, match="unknown"):
+        GradientCompression({"type": "2bit", "bogus": 1})
+
+
+def test_kvstore_push_applies_compression():
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    shape = (3, 3)
+    kv.init(0, mx.nd.zeros(shape))
+    kv.push(0, mx.nd.array(np.full(shape, 0.7, np.float32)))
+    out = mx.nd.zeros(shape)
+    kv.pull(0, out)
+    np.testing.assert_allclose(out.asnumpy(), 0.5)  # quantized to +t
+    # residual 0.2 carries into the next push: 0.2 + 0.4 > 0.5 → +t again
+    kv.push(0, mx.nd.array(np.full(shape, 0.4, np.float32)))
+    kv.pull(0, out)
+    np.testing.assert_allclose(out.asnumpy(), 0.5)
+    # third push: residual 0.1 + 0.1 = 0.2 < t → zeros
+    kv.push(0, mx.nd.array(np.full(shape, 0.1, np.float32)))
+    kv.pull(0, out)
+    np.testing.assert_allclose(out.asnumpy(), 0.0)
+
+
+def test_kvstore_multi_device_compression():
+    # replicas on several devices: each quantized independently then summed
+    from mxnet_tpu import parallel
+    ctxs = parallel.data_parallel_ctxs(2)
+    if len(ctxs) < 2:
+        pytest.skip("needs 2 devices")
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    shape = (4,)
+    kv.init(1, mx.nd.zeros(shape, ctx=ctxs[0]))
+    grads = [mx.nd.array(np.full(shape, 0.6, np.float32), ctx=ctxs[0]),
+             mx.nd.array(np.full(shape, -0.6, np.float32), ctx=ctxs[1])]
+    kv.push(1, grads)
+    out = mx.nd.zeros(shape, ctx=ctxs[0])
+    kv.pull(1, out)
+    np.testing.assert_allclose(out.asnumpy(), 0.0)  # +t + -t
+    grads = [mx.nd.array(np.full(shape, 0.6, np.float32), ctx=ctxs[0]),
+             mx.nd.array(np.full(shape, 0.7, np.float32), ctx=ctxs[1])]
+    kv.push(1, grads)
+    kv.pull(1, out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0)  # +t + +t

@@ -1,0 +1,38 @@
+"""Multi-process dist_tpu_sync integration test (reference
+tests/nightly/dist_sync_kvstore.py run under tools/launch.py --launcher
+local, SURVEY §4.2 'distributed without a cluster').
+
+Two REAL processes on the CPU platform, rendezvoused through
+jax.distributed on localhost; the kvstore reduce is the compiled
+shard_map psum over the process mesh — the same code path a TPU pod
+takes, minus the ICI."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def test_two_process_sync_kvstore():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        from launch import launch_local
+    finally:
+        sys.path.pop(0)
+    worker = os.path.join(repo, "tests", "_dist_worker.py")
+    env = {"MXNET_TPU_JIT_IMPERATIVE": "1"}
+    codes = launch_local(2, [sys.executable, worker], env_extra=env,
+                         cpu_devices_per_worker=1)
+    assert codes == [0, 0], f"worker exit codes {codes}"
+
+
+def test_launch_rejects_servers():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "-s", "1", "echo", "hi"],
+        capture_output=True, text=True)
+    assert res.returncode != 0
+    assert "no server role" in res.stderr

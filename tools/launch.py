@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Multi-process training launcher (reference tools/launch.py +
+3rdparty/dmlc-core/tracker, SURVEY N26/P22/§3.4).
+
+The reference spawns scheduler/server/worker processes over
+ssh/mpi/sge/yarn and wires them with DMLC_* env vars.  The TPU-native
+stack has NO server or scheduler processes (SURVEY §7.1 N13/N14/N17 rows):
+``jax.distributed`` needs only a coordinator address and one process per
+host, so this launcher:
+
+ - ``--launcher local`` (default): fork N worker processes on this machine
+   — the integration-test path, mirroring the reference's
+   ``--launcher local`` used by ``tests/nightly/dist_sync_kvstore.py``.
+   Each worker gets MXNET_DIST_COORDINATOR / MXNET_DIST_RANK /
+   MXNET_DIST_NUM_WORKERS (read by ``kvstore.create('dist_tpu_sync')``)
+   plus JAX CPU-platform vars so a laptop run uses N virtual CPU workers.
+ - ``--launcher ssh``: print the per-host commands (TPU pods normally come
+   up via the cloud runtime which IS the launcher; we document instead of
+   reimplementing ssh fan-out — each pod host runs the same command and
+   jax.distributed handles rendezvous).
+
+Usage:
+  python tools/launch.py -n 2 python train.py --kv-store dist_tpu_sync
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(n, command, env_extra=None, cpu_devices_per_worker=None,
+                 timeout=600):
+    """Spawn n local worker processes; returns their exit codes.
+
+    One hung worker must not hang the launch: after ``timeout`` seconds
+    (or once any worker fails, after a short grace) stragglers are killed
+    and reported with code -9."""
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env["MXNET_DIST_COORDINATOR"] = coord
+        env["MXNET_DIST_NUM_WORKERS"] = str(n)
+        env["MXNET_DIST_RANK"] = str(rank)
+        if cpu_devices_per_worker:
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{cpu_devices_per_worker}").strip()
+        procs.append(subprocess.Popen(command, env=env))
+    codes = [None] * n
+    import time as _time
+    deadline = _time.time() + timeout
+    while any(c is None for c in codes):
+        for i, p in enumerate(procs):
+            if codes[i] is None:
+                codes[i] = p.poll()
+        if all(c is not None for c in codes):
+            break
+        if _time.time() > deadline or any(c not in (None, 0) for c in codes):
+            # timeout, or a peer already failed (collectives would hang):
+            # give stragglers a short grace, then kill
+            grace = min(deadline, _time.time() + 15)
+            while _time.time() < grace and any(
+                    p.poll() is None for p in procs):
+                _time.sleep(0.2)
+            for i, p in enumerate(procs):
+                if p.poll() is None:
+                    p.kill()
+                    codes[i] = -9
+                else:
+                    codes[i] = p.returncode
+            break
+        _time.sleep(0.2)
+    return codes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="launch multi-process mxnet_tpu training "
+                    "(reference tools/launch.py analog)")
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference-CLI parity; the TPU stack "
+                         "has no server processes (optimizer stays on "
+                         "device) so this must be 0")
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="hostfile (one host per line) for --launcher ssh")
+    ap.add_argument("--cpu-devices", type=int, default=None,
+                    help="force each worker onto N virtual CPU devices "
+                         "(testing without TPUs)")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="training command to run on every worker")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no training command given")
+    if args.num_servers:
+        ap.error("dist_tpu_sync has no server role: run with -s 0 "
+                 "(the optimizer stays on device; SURVEY §7.1)")
+
+    if args.launcher == "ssh":
+        hosts = []
+        if args.hostfile:
+            with open(args.hostfile) as f:
+                hosts = [h.strip() for h in f if h.strip()]
+        print("# dist_tpu_sync has no scheduler/server processes; on a TPU "
+              "pod, run the SAME command on every host (the cloud runtime "
+              "sets the coordinator env) — equivalent ssh fan-out:")
+        coord = f"{hosts[0] if hosts else '<host0>'}:29400"
+        for rank, host in enumerate(hosts or
+                                    [f"<host{i}>" for i
+                                     in range(args.num_workers)]):
+            cmd = " ".join(args.command)
+            print(f"ssh {host} MXNET_DIST_COORDINATOR={coord} "
+                  f"MXNET_DIST_NUM_WORKERS={args.num_workers} "
+                  f"MXNET_DIST_RANK={rank} {cmd}")
+        return 0
+
+    codes = launch_local(args.num_workers, args.command,
+                         cpu_devices_per_worker=args.cpu_devices)
+    bad = [c for c in codes if c != 0]
+    if bad:
+        print(f"launch: {len(bad)}/{len(codes)} workers failed "
+              f"(codes {codes})", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
