@@ -65,6 +65,14 @@ KNOWN_VARS = {
         "If 1, imperative op dispatch goes through a per-(op,shape,dtype,attrs) "
         "jax.jit cache; if 0, ops run op-by-op eagerly."),
     "MXNET_SHOW_ENV": ("0", int, "Print the env-var catalog at import (1.7 parity)."),
+    "MXNET_PARAMS_FORMAT": (
+        "npz", str,
+        "Default mx.nd.save container: 'npz' (rich: sparse/bf16) or 'dmlc' "
+        "(the reference's byte-compatible .params layout). load() "
+        "auto-detects both."),
+    "MXNET_CHECKPOINT_KEEP": (
+        "3", int,
+        "How many step checkpoints mx.checkpoint.CheckpointManager retains."),
 }
 
 _lock = threading.Lock()

@@ -57,6 +57,24 @@ def _dequantize_2bit(packed, threshold, shape, dtype):
     return vals.reshape(shape)
 
 
+@functools.partial(__import__("jax").jit,
+                   static_argnames=("threshold", "shape", "dtype"))
+def _dequantize_sum_2bit(packed2d, threshold, shape, dtype):
+    """(P, nbytes) packed codes → sum of all P dequantized tensors, in ONE
+    dispatch (the dist hot path: P separate dequantize+add dispatches per
+    key per step would serialize host-side)."""
+    import jax.numpy as jnp
+    import numpy as np
+    n = int(np.prod(shape)) if shape else 1
+    c = packed2d[:, :, None] >> jnp.asarray([0, 2, 4, 6], jnp.uint8)[None, None, :]
+    codes = (c & 0x3).reshape(packed2d.shape[0], -1)[:, :n]
+    t = jnp.asarray(threshold, dtype)
+    # sum over contributors: t * (#code1 - #code2) per element
+    plus = (codes == 1).sum(axis=0).astype(dtype)
+    minus = (codes == 2).sum(axis=0).astype(dtype)
+    return ((plus - minus) * t).reshape(shape)
+
+
 class GradientCompression:
     """Per-key compressor state (reference GradientCompression).
 
@@ -97,3 +115,9 @@ class GradientCompression:
         import numpy as np
         return _dequantize_2bit(packed, self.threshold, tuple(shape),
                                 np.dtype(dtype).name)
+
+    def decompress_sum(self, packed2d, shape, dtype):
+        """Sum of all rows' dequantized tensors, one fused dispatch."""
+        import numpy as np
+        return _dequantize_sum_2bit(packed2d, self.threshold, tuple(shape),
+                                    np.dtype(dtype).name)

@@ -181,10 +181,7 @@ class KVStoreDistTPUSync(KVStoreLocal):
             packed, shape, dtype = self._compression.compress(
                 key, "dist", merged._data)
             gathered = self._gather_packed(packed)
-            total = None
-            for p in range(jax.process_count()):
-                vals = self._compression.decompress(gathered[p], shape, dtype)
-                total = vals if total is None else total + vals
+            total = self._compression.decompress_sum(gathered, shape, dtype)
             reduced = nd.NDArray._from_data(total, ctx=merged.ctx)
         else:
             if self._compression is not None:

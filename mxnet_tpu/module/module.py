@@ -21,6 +21,13 @@ from .. import optimizer as _opt
 from .. import initializer as _init
 from ..model import BatchEndParam, save_checkpoint, load_params
 
+
+def nd_concat_batch(parts):
+    """Concat per-ctx output slices along the batch axis (scalars stack)."""
+    if parts[0].ndim == 0:
+        return nd.stack(*parts, axis=0)
+    return nd.concat(*parts, dim=0)
+
 __all__ = ["BaseModule", "Module"]
 
 
@@ -151,9 +158,10 @@ class Module(BaseModule):
         self._symbol = symbol
         self._data_names = list(data_names)
         self._label_names = list(label_names or [])
-        self._context = context if context is not None else current_context()
-        if isinstance(self._context, (list, tuple)):
-            self._context = self._context[0]  # one executor spans the mesh
+        ctxs = context if context is not None else current_context()
+        self._contexts = list(ctxs) if isinstance(ctxs, (list, tuple)) \
+            else [ctxs]
+        self._context = self._contexts[0]
         self._fixed_param_names = set(fixed_param_names or [])
         arg_names = symbol.list_arguments()
         self._param_names = [n for n in arg_names
@@ -161,6 +169,7 @@ class Module(BaseModule):
                              and n not in self._label_names]
         self._aux_names = symbol.list_auxiliary_states()
         self._exec = None
+        self._execs = []
         self._optimizer = None
         self._updater = None
         self._kvstore = None
@@ -197,13 +206,31 @@ class Module(BaseModule):
             return
         self._data_shapes = data_shapes
         self._label_shapes = label_shapes
+        n_ctx = len(self._contexts)
         shapes = {}
         for desc in list(data_shapes) + list(label_shapes or []):
             name, shape = desc[0], desc[1]
             shapes[name] = shape
-        self._exec = self._symbol.simple_bind(
-            ctx=self._context,
-            grad_req=grad_req if for_training else "null", **shapes)
+        if n_ctx > 1:
+            # data parallelism across ctxs: one executor per context, each
+            # on an even batch slice (reference module/executor_group.py ::
+            # DataParallelExecutorGroup)
+            for name, shape in shapes.items():
+                if shape[0] % n_ctx:
+                    raise MXNetError(
+                        f"batch dim of {name!r} ({shape[0]}) must divide "
+                        f"evenly over {n_ctx} contexts (reference splits by "
+                        "workload; even split here)")
+            sliced = {n: (s[0] // n_ctx,) + tuple(s[1:])
+                      for n, s in shapes.items()}
+            self._execs = [self._symbol.simple_bind(
+                ctx=c, grad_req=grad_req if for_training else "null",
+                **sliced) for c in self._contexts]
+        else:
+            self._execs = [self._symbol.simple_bind(
+                ctx=self._context,
+                grad_req=grad_req if for_training else "null", **shapes)]
+        self._exec = self._execs[0]
         self.binded = True
         self.for_training = for_training
 
@@ -225,7 +252,21 @@ class Module(BaseModule):
                 arr._set_data(aux_params[name]._data)
             else:
                 initializer(_init.InitDesc(name), arr)
+        self._broadcast_params()
         self.params_initialized = True
+
+    def _broadcast_params(self):
+        """Replicate exec0's params/aux to every other context's executor
+        (reference executor_group param sync)."""
+        for e in self._execs[1:]:
+            for name in self._param_names:
+                e.arg_dict[name]._set_data(
+                    self._exec.arg_dict[name].as_in_context(
+                        e.arg_dict[name].ctx)._data)
+            for name in self._aux_names:
+                e.aux_dict[name]._set_data(
+                    self._exec.aux_dict[name].as_in_context(
+                        e.aux_dict[name].ctx)._data)
 
     def get_params(self):
         arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
@@ -249,20 +290,35 @@ class Module(BaseModule):
         self._updater = _opt.get_updater(optimizer)
         self.optimizer_initialized = True
 
+    def _slice_for(self, arr, k):
+        """k-th even batch slice of arr, on the k-th context."""
+        n = len(self._execs)
+        if n == 1:
+            return arr
+        per = arr.shape[0] // n
+        return arr[k * per:(k + 1) * per].as_in_context(self._contexts[k])
+
     def forward(self, data_batch, is_train=None):
         if is_train is None:
             is_train = self.for_training
-        feed = {}
-        for name, arr in zip(self._data_names, data_batch.data):
-            feed[name] = arr
-        if data_batch.label is not None:
-            for name, arr in zip(self._label_names, data_batch.label):
-                if name in self._exec.arg_dict:
-                    feed[name] = arr
-        self._exec.forward(is_train=is_train, **feed)
+        for k, e in enumerate(self._execs):
+            feed = {}
+            for name, arr in zip(self._data_names, data_batch.data):
+                feed[name] = self._slice_for(arr, k)
+            if data_batch.label is not None:
+                for name, arr in zip(self._label_names, data_batch.label):
+                    if name in e.arg_dict:
+                        feed[name] = self._slice_for(arr, k)
+            e.forward(is_train=is_train, **feed)
 
     def backward(self, out_grads=None):
-        self._exec.backward(out_grads)
+        for k, e in enumerate(self._execs):
+            if out_grads is None:
+                e.backward(None)
+            else:
+                ogs = out_grads if isinstance(out_grads, (list, tuple)) \
+                    else [out_grads]
+                e.backward([self._slice_for(g, k) for g in ogs])
 
     def update(self):
         for i, name in enumerate(self._param_names):
@@ -271,13 +327,44 @@ class Module(BaseModule):
             g = self._exec.grad_dict.get(name)
             if g is None:
                 continue
+            if len(self._execs) > 1:
+                # sum grads across ctx replicas (DataParallelExecutorGroup
+                # grad aggregation), update once, broadcast the result
+                for e in self._execs[1:]:
+                    g = g + e.grad_dict[name].as_in_context(g.ctx)
             self._updater(i, g, self._exec.arg_dict[name])
+        if len(self._execs) > 1:
+            self._broadcast_params()
 
-    def get_outputs(self, merge_multi_context=True):  # noqa: ARG002
-        return self._exec.outputs
+    def get_outputs(self, merge_multi_context=True):
+        if len(self._execs) == 1:
+            return self._exec.outputs
+        if not merge_multi_context:
+            # reference contract: grouped per OUTPUT, inner list per ctx
+            return [[e.outputs[i] for e in self._execs]
+                    for i in range(len(self._exec.outputs))]
+        merged = []
+        for i in range(len(self._exec.outputs)):
+            parts = [e.outputs[i].as_in_context(self._context)
+                     for e in self._execs]
+            merged.append(nd_concat_batch(parts))
+        return merged
 
-    def get_input_grads(self, merge_multi_context=True):  # noqa: ARG002
-        return [self._exec.grad_dict.get(n) for n in self._data_names]
+    def get_input_grads(self, merge_multi_context=True):
+        if len(self._execs) == 1:
+            return [self._exec.grad_dict.get(n) for n in self._data_names]
+        if not merge_multi_context:
+            return [[e.grad_dict.get(n) for e in self._execs]
+                    for n in self._data_names]
+        merged = []
+        for n in self._data_names:
+            parts = [e.grad_dict.get(n) for e in self._execs]
+            if any(p is None for p in parts):
+                merged.append(None)
+                continue
+            merged.append(nd_concat_batch(
+                [p.as_in_context(self._context) for p in parts]))
+        return merged
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):  # noqa: ARG002
         eval_metric.update(labels, self.get_outputs())

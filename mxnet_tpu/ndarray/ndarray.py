@@ -807,10 +807,34 @@ def waitall():
 _SAVE_MAGIC = "mxnet_tpu.params.v1"
 
 
-def save(fname, data):
-    payload = {"__magic__": _np.frombuffer(_SAVE_MAGIC.encode(), dtype=_np.uint8)}
+def save(fname, data, format=None):  # noqa: A002 — reference-style kwarg
+    """Save NDArrays (reference mx.nd.save → MXNDArraySave).
+
+    format: 'dmlc' writes the reference's byte-compatible .params layout
+    (dmlc_params.py) so files interchange with upstream MXNet; 'npz'
+    (default) is this framework's richer container (sparse, bf16).
+    MXNET_PARAMS_FORMAT flips the default.  ``load`` auto-detects both.
+    """
+    from .. import config as _cfg
+    if format is None:
+        format = _cfg.get("MXNET_PARAMS_FORMAT", "npz")
     if isinstance(data, NDArray):
         data = [data]
+    if format == "dmlc":
+        from .. import dmlc_params
+        if isinstance(data, dict):
+            names = list(data)
+            arrays = [data[k].asnumpy() for k in names]
+        elif isinstance(data, (list, tuple)):
+            names, arrays = [], [v.asnumpy() for v in data]
+        else:
+            raise MXNetError("save expects NDArray, list or dict of NDArrays")
+        with open(fname, "wb") as f:
+            f.write(dmlc_params.save_bytes(arrays, names))
+        return
+    if format != "npz":
+        raise MXNetError(f"unknown params format {format!r}: npz or dmlc")
+    payload = {"__magic__": _np.frombuffer(_SAVE_MAGIC.encode(), dtype=_np.uint8)}
     if isinstance(data, dict):
         for k, v in data.items():
             payload["name:" + k] = v.asnumpy()
@@ -824,6 +848,18 @@ def save(fname, data):
 
 
 def load(fname, ctx=None):
+    """Load NDArrays; auto-detects the reference dmlc .params byte format
+    (files written by upstream mx.nd.save load directly) and the npz
+    container."""
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    from .. import dmlc_params
+    if dmlc_params.is_dmlc_params(head):
+        with open(fname, "rb") as f:
+            arrays, names = dmlc_params.load_bytes(f.read())
+        if names:
+            return {n: array(a, ctx=ctx) for n, a in zip(names, arrays)}
+        return [array(a, ctx=ctx) for a in arrays]
     with _np.load(fname, allow_pickle=False) as z:
         keys = [k for k in z.files if k != "__magic__"]
         if keys and keys[0].startswith("name:"):

@@ -395,6 +395,14 @@ class Updater:
         if index not in self.states:
             self.states[index] = \
                 self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        elif not self.states_synced.get(index, True):
+            # states deserialized by set_states land on the default ctx;
+            # move them to the weight's ctx on first use (reference
+            # Updater.sync_state_context)
+            self.states[index] = _state_to_ctx(self.states[index],
+                                               weight.ctx)
+            self.states_synced[index] = True
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
 
@@ -403,12 +411,25 @@ class Updater:
         flat = {}
         for k, st in self.states.items():
             flat[k] = _state_to_numpy(st)
-        return pickle.dumps(flat)
+        # update counts ride along: without them a resumed Adam/LAMB run
+        # restarts bias correction at t=0 and the loss curve diverges
+        payload = {"states": flat,
+                   "index_update_count": dict(
+                       self.optimizer._index_update_count),
+                   "num_update": self.optimizer.num_update}
+        return pickle.dumps(payload)
 
     def set_states(self, states):
         import pickle
         flat = pickle.loads(states)
+        if isinstance(flat, dict) and "states" in flat \
+                and "num_update" in flat:
+            self.optimizer._index_update_count = dict(
+                flat["index_update_count"])
+            self.optimizer.num_update = flat["num_update"]
+            flat = flat["states"]
         self.states = {k: _state_from_numpy(v) for k, v in flat.items()}
+        self.states_synced = {k: False for k in self.states}
 
 
 def _state_to_numpy(st):
@@ -425,6 +446,14 @@ def _state_from_numpy(st):
     if isinstance(st, (list, tuple)):
         return type(st)(_state_from_numpy(s) for s in st)
     return nd.array(st)
+
+
+def _state_to_ctx(st, ctx):
+    if st is None:
+        return None
+    if isinstance(st, (list, tuple)):
+        return type(st)(_state_to_ctx(s, ctx) for s in st)
+    return st.as_in_context(ctx)
 
 
 def get_updater(optimizer):

@@ -1,0 +1,174 @@
+"""Checkpoint tests: dmlc .params byte format + orbax manager +
+kill-and-resume loss-curve reproduction (VERDICT r2 next-round item 8)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# dmlc .params byte format
+# ---------------------------------------------------------------------------
+
+def test_dmlc_roundtrip_dict(tmp_path):
+    f = str(tmp_path / "x.params")
+    data = {"arg:w": mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3)),
+            "arg:b": mx.nd.array(np.array([1.5], np.float64)),
+            "aux:i": mx.nd.array(np.array([[7, 8]], np.int64))}
+    mx.nd.save(f, data, format="dmlc")
+    out = mx.nd.load(f)
+    assert set(out) == set(data)
+    for k in data:
+        np.testing.assert_array_equal(out[k].asnumpy(), data[k].asnumpy())
+        assert out[k].dtype == data[k].dtype
+
+
+def test_dmlc_roundtrip_list(tmp_path):
+    f = str(tmp_path / "l.params")
+    data = [mx.nd.ones((3,)), mx.nd.zeros((2, 2))]
+    mx.nd.save(f, data, format="dmlc")
+    out = mx.nd.load(f)
+    assert isinstance(out, list) and len(out) == 2
+    np.testing.assert_array_equal(out[0].asnumpy(), 1.0)
+
+
+def test_dmlc_exact_golden_bytes(tmp_path):
+    # pin the byte layout (reference ndarray.cc NDArray::Save): any format
+    # drift breaks interchange silently — assert the exact bytes
+    from mxnet_tpu import dmlc_params
+    arr = np.array([[1.0, 2.0]], np.float32)
+    blob = dmlc_params.save_bytes([arr], ["arg:w"])
+    expect = b"".join([
+        struct.pack("<QQ", 0x112, 0),          # list magic + reserved
+        struct.pack("<Q", 1),                  # one array
+        struct.pack("<I", 0xF993FAC9),         # NDArray V2 magic
+        struct.pack("<i", 0),                  # dense stype
+        struct.pack("<I", 2),                  # ndim
+        struct.pack("<qq", 1, 2),              # int64 dims
+        struct.pack("<ii", 1, 0),              # cpu:0
+        struct.pack("<i", 0),                  # type_flag f32
+        arr.tobytes(),
+        struct.pack("<Q", 1),                  # one name
+        struct.pack("<Q", 5), b"arg:w",
+    ])
+    assert blob == expect
+    back, names = dmlc_params.load_bytes(blob)
+    np.testing.assert_array_equal(back[0], arr)
+    assert names == ["arg:w"]
+
+
+def test_dmlc_reads_v1_era_32bit_dims():
+    # V1-era files carried 32-bit dims; the reader probes both widths
+    from mxnet_tpu import dmlc_params
+    arr = np.array([3.0, 4.0, 5.0], np.float32)
+    blob = b"".join([
+        struct.pack("<QQ", 0x112, 0), struct.pack("<Q", 1),
+        struct.pack("<I", 0xF993FAC9), struct.pack("<i", 0),
+        struct.pack("<I", 1), struct.pack("<i", 3),   # 32-bit dim
+        struct.pack("<ii", 1, 0), struct.pack("<i", 0),
+        arr.tobytes(), struct.pack("<Q", 0),
+    ])
+    back, names = dmlc_params.load_bytes(blob)
+    np.testing.assert_array_equal(back[0], arr)
+
+
+def test_dmlc_rejects_garbage():
+    from mxnet_tpu import dmlc_params
+    with pytest.raises(MXNetError, match="magic"):
+        dmlc_params.load_bytes(b"\x00" * 64)
+    assert not dmlc_params.is_dmlc_params(b"PK\x03\x04....")
+
+
+def test_npz_default_unchanged(tmp_path):
+    f = str(tmp_path / "y.params")
+    mx.nd.save(f, {"w": mx.nd.ones((2,))})
+    with open(f, "rb") as fh:
+        assert fh.read(2) == b"PK"  # zip container (np.savez)
+    out = mx.nd.load(f)
+    np.testing.assert_array_equal(out["w"].asnumpy(), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# orbax manager + auto-resume
+# ---------------------------------------------------------------------------
+
+def _make_net_trainer(lr=0.05):
+    mx.random.seed(7)
+    # fixed prefix: checkpoint keys are structural names, and the global
+    # name counter would otherwise differ between the two "processes"
+    net = gluon.nn.Dense(4, in_units=6, prefix="net_")
+    net.initialize(mx.initializer.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": lr})
+    return net, tr
+
+
+def _step(net, tr, x, y, lossf):
+    with autograd.record():
+        loss = lossf(net(x), y)
+    loss.backward()
+    tr.step(x.shape[0])
+    return float(loss.mean().asnumpy())
+
+
+def test_checkpoint_manager_roundtrip(tmp_path):
+    net, tr = _make_net_trainer()
+    x = mx.nd.ones((8, 6))
+    y = mx.nd.array(np.arange(8) % 4)
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    _step(net, tr, x, y, lossf)
+    mgr = mx.checkpoint.CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+    assert mgr.latest_step() is None
+    mgr.save(0, net=net, trainer=tr, extra={"epoch": mx.nd.array([3.0])})
+    w0 = list(net.collect_params().values())[0].data().asnumpy().copy()
+    _step(net, tr, x, y, lossf)  # mutate
+    step, extra = mgr.restore(net=net, trainer=tr)
+    assert step == 0
+    np.testing.assert_allclose(
+        list(net.collect_params().values())[0].data().asnumpy(), w0)
+    assert float(extra["epoch"].asnumpy()[0]) == 3.0
+
+
+def test_kill_and_resume_reproduces_loss_curve(tmp_path):
+    # VERDICT acceptance: kill mid-training and resume; the resumed curve
+    # must equal the unkilled one (params + adam state + step counts)
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    r = np.random.RandomState(0)
+    X = mx.nd.array(r.randn(8, 6).astype(np.float32))
+    Y = mx.nd.array(r.randint(0, 4, (8,)))
+    total = 8
+
+    # unkilled reference run
+    net, tr = _make_net_trainer()
+    ref = [_step(net, tr, X, Y, lossf) for _ in range(total)]
+
+    # killed run: stop after 3 steps...
+    ckdir = str(tmp_path / "resume")
+    losses_a = []
+
+    def run_a(step):
+        losses_a.append(_step(*state_a, X, Y, lossf))
+        return step < 2  # steps 0,1,2 then stop (simulated preemption)
+
+    state_a = _make_net_trainer()
+    mx.checkpoint.auto_resume(run_a, ckdir, net=state_a[0],
+                              trainer=state_a[1], save_every=1)
+
+    # ...new process: fresh objects, resume from the checkpoint dir
+    losses_b = []
+
+    def run_b(step):
+        losses_b.append(_step(*state_b, X, Y, lossf))
+        return step < total - 1
+
+    state_b = _make_net_trainer()  # fresh (different) init — must be overwritten
+    last = mx.checkpoint.auto_resume(run_b, ckdir, net=state_b[0],
+                                     trainer=state_b[1], save_every=1)
+    assert last == total - 1
+    curve = losses_a + losses_b
+    assert len(curve) == total
+    np.testing.assert_allclose(curve, ref, rtol=1e-5, atol=1e-6)

@@ -31,12 +31,30 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
 
 
+def _maybe_init_distributed():
+    """Join the jax.distributed rendezvous when launched by tools/launch.py
+    (must happen before any backend query like process_count)."""
+    import jax
+    coord = os.environ.get("MXNET_DIST_COORDINATOR")
+    if coord:
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(os.environ["MXNET_DIST_NUM_WORKERS"]),
+                process_id=int(os.environ["MXNET_DIST_RANK"]))
+        except RuntimeError:
+            pass  # already initialized
+
+
 def measure(sizes_mb, iters=5, use_dist=None):
     import jax
     import numpy as np
     import mxnet_tpu as mx
     from mxnet_tpu import parallel
 
+    _maybe_init_distributed()
     n_proc = jax.process_count()
     dist = use_dist if use_dist is not None else n_proc > 1
     rows = []
@@ -80,6 +98,7 @@ def main(argv=None):
     sizes = [float(s) for s in args.sizes_mb.split(",") if s]
     rows = measure(sizes, args.iters)
     import jax
+    _maybe_init_distributed()
     if jax.process_index() == 0:
         for r in rows:
             print(json.dumps(r))
