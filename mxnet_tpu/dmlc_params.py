@@ -124,29 +124,40 @@ def _read_ndarray(r):
             raise MXNetError(
                 "sparse arrays in .params are not supported by this bridge "
                 "(use the npz default for row_sparse/csr)")
-    # dims width heuristic: try int64 dims, validate by checking the
-    # following dev_type field lands on a small positive int
+    # dims width probe: TShape dims were 32-bit in early files and 64-bit
+    # from ~1.5 on, under the SAME magics.  Validate the WHOLE header
+    # (dev fields, type_flag, and that the data payload fits in the
+    # remaining buffer) before committing to a width, so e.g. a 2-D f64
+    # 32-bit-dims array can't masquerade as a garbage 64-bit shape.
     start = r.pos
-    for dim64 in (True, False):
+    widths = (True, False) if magic == _V2_MAGIC else (False, True)
+    parsed = None
+    for dim64 in widths:
         try:
             r.pos = start
             shape = _read_shape(r, dim64)
             dev_type = r.i32()
             dev_id = r.i32()
-            if 0 < dev_type <= 16 and 0 <= dev_id < 4096 and \
-                    all(0 <= d < 2 ** 48 for d in shape):
-                break
+            flag = r.i32()
+            if not (0 < dev_type <= 16 and 0 <= dev_id < 4096):
+                continue
+            if flag not in _TYPE_FLAGS or \
+                    not all(0 <= d < 2 ** 48 for d in shape):
+                continue
+            n = 1
+            for d in shape:
+                n *= d
+            nbytes = n * _np.dtype(_TYPE_FLAGS[flag]).itemsize
+            if r.pos + nbytes > len(r.buf):
+                continue  # payload can't fit — wrong width
+            parsed = (shape, flag, n)
+            break
         except (MXNetError, struct.error):
             continue
-    else:
+    if parsed is None:
         raise MXNetError("could not parse .params shape block")
-    flag = r.i32()
-    if flag not in _TYPE_FLAGS:
-        raise MXNetError(f"unknown type_flag {flag} in .params file")
+    shape, flag, n = parsed
     dt = _np.dtype(_TYPE_FLAGS[flag])
-    n = 1
-    for d in shape:
-        n *= d
     data = _np.frombuffer(r.take(n * dt.itemsize), dtype=dt).reshape(shape)
     return data.copy()
 

@@ -334,6 +334,15 @@ class Module(BaseModule):
                     g = g + e.grad_dict[name].as_in_context(g.ctx)
             self._updater(i, g, self._exec.arg_dict[name])
         if len(self._execs) > 1:
+            # aux states (BN running stats) were updated per slice: average
+            # them onto exec0 before the broadcast, else slice 0's stats
+            # silently win (reference executor_group merges aux across ctxs)
+            for name in self._aux_names:
+                acc = self._exec.aux_dict[name]
+                for e in self._execs[1:]:
+                    acc = acc + e.aux_dict[name].as_in_context(acc.ctx)
+                self._exec.aux_dict[name]._set_data(
+                    (acc / len(self._execs))._data)
             self._broadcast_params()
 
     def get_outputs(self, merge_multi_context=True):

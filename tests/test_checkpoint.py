@@ -77,6 +77,23 @@ def test_dmlc_reads_v1_era_32bit_dims():
     np.testing.assert_array_equal(back[0], arr)
 
 
+def test_dmlc_reads_v1_era_2d_f64():
+    # the width probe must not let int64 parsing swallow a 2-D 32-bit-dims
+    # header (code-review regression: f64 (3,4) misparsed as a huge shape)
+    from mxnet_tpu import dmlc_params
+    arr = np.zeros((3, 4), np.float64)
+    arr[0, 1] = 2.5
+    blob = b"".join([
+        struct.pack("<QQ", 0x112, 0), struct.pack("<Q", 1),
+        struct.pack("<I", 0xF993FAC9), struct.pack("<i", 0),
+        struct.pack("<I", 2), struct.pack("<ii", 3, 4),  # 32-bit dims
+        struct.pack("<ii", 1, 0), struct.pack("<i", 1),  # f64
+        arr.tobytes(), struct.pack("<Q", 0),
+    ])
+    back, _ = dmlc_params.load_bytes(blob)
+    np.testing.assert_array_equal(back[0], arr)
+
+
 def test_dmlc_rejects_garbage():
     from mxnet_tpu import dmlc_params
     with pytest.raises(MXNetError, match="magic"):

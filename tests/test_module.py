@@ -141,3 +141,38 @@ def test_module_multi_ctx_requires_divisible_batch():
     with pytest.raises(mx.base.MXNetError, match="divide"):
         mod.bind(data_shapes=[("data", (21, 10))],
                  label_shapes=[("softmax_label", (21,))])
+
+
+def test_module_multi_ctx_merges_bn_aux(seeded):
+    # BN running stats must reflect BOTH batch slices (averaged across
+    # executors), not just slice 0's
+    from mxnet_tpu import parallel
+    ctxs = parallel.data_parallel_ctxs(2)
+    if len(ctxs) < 2:
+        pytest.skip("needs 2 devices")
+    data = mx.sym.var("data")
+    net = mx.sym.BatchNorm(data, name="bn", fix_gamma=False)
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",), context=ctxs)
+    mod.bind(data_shapes=[("data", (8, 3))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.initializer.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd")
+    # slice 0 gets zeros, slice 1 gets large values: stats must see both
+    X = np.concatenate([np.zeros((4, 3), np.float32),
+                        np.full((4, 3), 10.0, np.float32)])
+    batch = mx.io.DataBatch(data=[mx.nd.array(X)],
+                            label=[mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    aux = {n: mod._exec.aux_dict[n].asnumpy() for n in mod._aux_names}
+    mean_name = next(n for n in aux if "mean" in n)
+    # slice-0-only stats would be ~0; merged stats reflect the 10.0 slice
+    assert aux[mean_name].mean() > 0.1, aux[mean_name]
+    # every executor carries the SAME merged aux after update
+    for e in mod._execs[1:]:
+        np.testing.assert_allclose(e.aux_dict[mean_name].asnumpy(),
+                                   aux[mean_name], rtol=1e-6)

@@ -96,9 +96,8 @@ def main(argv=None):
     ap.add_argument("--iters", type=int, default=5)
     args = ap.parse_args(argv)
     sizes = [float(s) for s in args.sizes_mb.split(",") if s]
-    rows = measure(sizes, args.iters)
+    rows = measure(sizes, args.iters)  # initializes distributed if launched
     import jax
-    _maybe_init_distributed()
     if jax.process_index() == 0:
         for r in rows:
             print(json.dumps(r))
