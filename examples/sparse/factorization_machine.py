@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Factorization machine over the sparse parameter-server path
+(BASELINE config 4; reference example/sparse/factorization_machine/).
+
+The embedding tables (linear weights ``w`` (N,1) and factors ``v`` (N,K))
+live in the host KV service (``kvstore/sparse_ps.py`` — the surviving PS
+role, SURVEY §5.8): each step pulls ONLY the rows the batch touches via
+``row_sparse_pull``, computes the FM forward/backward on-device over the
+gathered blocks, and pushes row-sparse grads back where the server-side
+optimizer applies the lazy update.  With N >> HBM this is the reference's
+sharded-embedding workflow.
+
+    y = sigmoid(w0 + X.w + 0.5 * sum_k[(X v_k)^2 - X^2 v_k^2])
+
+Synthetic sparse data: ``nnz`` active features per sample out of
+``num_features``.  Prints one JSON line with samples/sec.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# runnable from a repo checkout without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def run(num_features=100_000, factor_dim=8, batch_size=256, nnz=20,
+        batches=50, lr=0.05, seed=0, log=True):
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    rng = np.random.RandomState(seed)
+    from mxnet_tpu.ndarray import sparse as sp
+    kv = mx.kv.create("dist_tpu_sync")
+    # row_sparse stype routes these keys onto the host PS (reference:
+    # variables declared stype='row_sparse' live sharded on the servers)
+    kv.init("w", sp.cast_storage(mx.nd.zeros((num_features, 1)),
+                                 "row_sparse"))
+    kv.init("v", sp.cast_storage(
+        mx.nd.array(rng.randn(num_features, factor_dim)
+                    .astype(np.float32) * 0.01), "row_sparse"))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=lr))
+    w0 = mx.nd.zeros((1,))
+    w0.attach_grad()
+
+    # ground truth for the synthetic task
+    true_w = rng.randn(num_features).astype(np.float32)
+
+    def batch():
+        ids = rng.randint(0, num_features, (batch_size, nnz))
+        vals = rng.rand(batch_size, nnz).astype(np.float32)
+        logits = (vals * true_w[ids]).sum(axis=1)
+        y = (logits > 0).astype(np.float32)
+        return ids, vals, y
+
+    losses = []
+    t0 = time.perf_counter()
+    for it in range(batches):
+        ids, vals, y = batch()
+        uniq, inv = np.unique(ids, return_inverse=True)
+        inv = inv.reshape(ids.shape)
+        # pull just the touched rows from the host PS
+        w_rows = kv.row_sparse_pull("w", row_ids=mx.nd.array(uniq))
+        v_rows = kv.row_sparse_pull("v", row_ids=mx.nd.array(uniq))
+        wb = w_rows.data.copy()
+        vb = v_rows.data.copy()
+        wb.attach_grad()
+        vb.attach_grad()
+        xv = mx.nd.array(vals)
+        inv_nd = mx.nd.array(inv.reshape(-1))
+        yl = mx.nd.array(y)
+        with autograd.record():
+            # gather per-position rows: (B*nnz, ...) → (B, nnz, ...)
+            wg = mx.nd.take(wb, inv_nd, axis=0).reshape(
+                (batch_size, nnz))
+            vg = mx.nd.take(vb, inv_nd, axis=0).reshape(
+                (batch_size, nnz, factor_dim))
+            linear = (xv * wg).sum(axis=1)
+            xvf = xv.expand_dims(-1) * vg          # (B, nnz, K)
+            inter = 0.5 * ((xvf.sum(axis=1) ** 2).sum(axis=1)
+                           - (xvf ** 2).sum(axis=(1, 2)))
+            logits = w0 + linear + inter
+            # logistic loss
+            loss = mx.nd.relu(logits) - logits * yl + \
+                mx.nd.log1p(mx.nd.exp(-mx.nd.abs(logits)))
+            loss = loss.mean()
+        loss.backward()
+        losses.append(float(loss.asnumpy()))
+        # push row-sparse grads; the PS applies the lazy server-side update
+        kv.push("w", RowSparseNDArray(
+            wb.grad.reshape((-1, 1)), mx.nd.array(uniq),
+            (num_features, 1)))
+        kv.push("v", RowSparseNDArray(
+            vb.grad, mx.nd.array(uniq), (num_features, factor_dim)))
+        w0 -= lr * w0.grad
+        w0.grad[:] = mx.nd.zeros((1,))
+        if log and it % 10 == 0:
+            print(f"batch {it}: loss {losses[-1]:.4f}", file=sys.stderr)
+    dt = time.perf_counter() - t0
+    sps = batch_size * batches / dt
+    result = {"metric": "fm_sparse_ps_samples_per_sec",
+              "value": round(sps, 1), "unit": "samples/s",
+              "loss_first": round(float(np.mean(losses[:5])), 4),
+              "loss_last": round(float(np.mean(losses[-5:])), 4),
+              "num_features": num_features, "factor_dim": factor_dim}
+    if log:
+        print(json.dumps(result))
+    return result, losses
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-features", type=int, default=100_000)
+    ap.add_argument("--factor-dim", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--nnz", type=int, default=20)
+    ap.add_argument("--batches", type=int, default=50)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args(argv)
+    run(args.num_features, args.factor_dim, args.batch_size, args.nnz,
+        args.batches, args.lr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

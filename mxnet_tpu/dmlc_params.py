@@ -132,6 +132,7 @@ def _read_ndarray(r):
     start = r.pos
     widths = (True, False) if magic == _V2_MAGIC else (False, True)
     parsed = None
+    reasons = []
     for dim64 in widths:
         try:
             r.pos = start
@@ -140,22 +141,30 @@ def _read_ndarray(r):
             dev_id = r.i32()
             flag = r.i32()
             if not (0 < dev_type <= 16 and 0 <= dev_id < 4096):
+                reasons.append(f"implausible ctx ({dev_type},{dev_id})")
                 continue
-            if flag not in _TYPE_FLAGS or \
-                    not all(0 <= d < 2 ** 48 for d in shape):
+            if flag not in _TYPE_FLAGS:
+                reasons.append(f"unknown type_flag {flag}")
+                continue
+            if not all(0 <= d < 2 ** 48 for d in shape):
+                reasons.append(f"implausible shape {shape}")
                 continue
             n = 1
             for d in shape:
                 n *= d
             nbytes = n * _np.dtype(_TYPE_FLAGS[flag]).itemsize
             if r.pos + nbytes > len(r.buf):
-                continue  # payload can't fit — wrong width
+                reasons.append(f"payload {nbytes}B exceeds file")
+                continue  # wrong width
             parsed = (shape, flag, n)
             break
-        except (MXNetError, struct.error):
+        except (MXNetError, struct.error) as e:
+            reasons.append(str(e))
             continue
     if parsed is None:
-        raise MXNetError("could not parse .params shape block")
+        raise MXNetError(
+            "could not parse .params array header: "
+            + "; ".join(reasons or ["empty header"]))
     shape, flag, n = parsed
     dt = _np.dtype(_TYPE_FLAGS[flag])
     data = _np.frombuffer(r.take(n * dt.itemsize), dtype=dt).reshape(shape)

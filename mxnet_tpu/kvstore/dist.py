@@ -30,12 +30,91 @@ from .. import ndarray as nd
 from .local import KVStoreLocal
 
 
+def _merge_rowsparse(vals):
+    """Concat replica row-sparse grads into one (the PS merges duplicate
+    rows); a single value passes through."""
+    if len(vals) == 1:
+        return vals[0]
+    from ..ndarray import sparse as sp
+    from .. import ndarray as nd
+    import numpy as _np
+    rows = _np.concatenate([_np.asarray(v.indices.asnumpy(), _np.int64)
+                            for v in vals])
+    data = _np.concatenate([v.data.asnumpy() for v in vals], axis=0)
+    return sp.RowSparseNDArray(nd.array(data), nd.array(rows),
+                               vals[0].shape)
+
+
 class KVStoreDistTPUSync(KVStoreLocal):
     def __init__(self, name="dist_tpu_sync"):
         super().__init__(name=name)
         self._initialized = False
         self._mesh = None
         self._psum_cache = {}
+        self._sparse_ps = None  # host KV service, created on first sparse key
+
+    def _ps(self):
+        if self._sparse_ps is None:
+            from .sparse_ps import SparsePS
+            self._sparse_ps = SparsePS()
+        return self._sparse_ps
+
+    # -- sparse keys: the host PS path (reference kvstore_dist_server role) --
+    def init(self, key, value):
+        from ..ndarray import sparse as sp
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        v = value[0] if isinstance(value, (list, tuple)) else value
+        if isinstance(v, sp.BaseSparseNDArray) or \
+                getattr(v, "stype", "default") == "row_sparse":
+            self._ps().init(key, v)
+            return
+        super().init(key, value)
+
+    def set_optimizer(self, optimizer):
+        super().set_optimizer(optimizer)
+        self._ps().set_optimizer(optimizer)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)) and len(key) == 1:
+            key = key[0]
+        if self._is_sparse_key(key):
+            dense = self._ps().pull_dense(key)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o in outs:
+                o._set_data(dense.as_in_context(o.ctx)._data)
+            return
+        return super().pull(key, out=out, priority=priority,
+                            ignore_sparse=ignore_sparse)
+
+    def _is_sparse_key(self, key):
+        return self._sparse_ps is not None \
+            and not isinstance(key, (list, tuple)) \
+            and key in self._sparse_ps._tables
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        if isinstance(key, (list, tuple)) and len(key) == 1:
+            key = key[0]
+        if self._is_sparse_key(key):
+            if row_ids is None:
+                raise MXNetError("row_sparse_pull requires row_ids")
+            if out is None:
+                rids = row_ids[0] if isinstance(row_ids, (list, tuple)) \
+                    else row_ids
+                return self._ps().row_sparse_pull(key, rids)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            rids = row_ids if isinstance(row_ids, (list, tuple)) \
+                else [row_ids] * len(outs)
+            ret = None
+            for o, r in zip(outs, rids):  # per-out row sets (base contract)
+                ret = self._ps().row_sparse_pull(key, r)
+                o.data._set_data(ret.data._data)
+                o.indices._set_data(ret.indices._data)
+            return ret
+        return super().row_sparse_pull(key, out=out, priority=priority,
+                                       row_ids=row_ids)
 
     # -- bootstrap (the dmlc_tracker/scheduler role) -------------------------
     def _ensure_dist(self):
@@ -164,6 +243,13 @@ class KVStoreDistTPUSync(KVStoreLocal):
         if isinstance(key, (list, tuple)):
             key, value = key[0], value[0] if isinstance(value, (list, tuple)) \
                 else value
+        if self._is_sparse_key(key):
+            vals = value if isinstance(value, (list, tuple)) else [value]
+            # aggregate replica grads into ONE grad, then ONE server update
+            # (reference merge-buffer-then-update; per-replica updates would
+            # advance stateful optimizers once per replica)
+            self._ps().push(key, _merge_rowsparse(vals))
+            return
         # NOTE: local replica reduction only — per-process compression and
         # the cross-process wire step happen below, once, so super().push
         # must not re-compress (we call _store_merged directly)

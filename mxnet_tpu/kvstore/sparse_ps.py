@@ -1,0 +1,201 @@
+"""Host-resident sharded KV service for row-sparse parameters — the
+surviving parameter-server role (SURVEY §5.8/§7.1: "PS semantics retained
+ONLY for sparse embeddings").
+
+Reference: ``src/kvstore/kvstore_dist_server.h`` (N14: the server stores
+the table, aggregates sparse grads, runs the optimizer server-side) +
+``kvstore_dist.h :: PullRowSparse`` (N13) + the lazy sparse update
+semantics of ``src/operator/optimizer_op.cc`` (row_sparse sgd/adagrad:
+ONLY touched rows advance).
+
+TPU-native shape: embedding tables too big for HBM stay in host RAM as
+numpy shards (row-hashed over ``num_shards``); the training step pulls
+just the rows a batch touches (``row_sparse_pull``) onto the device, and
+pushes row-sparse grads back, where the SAME python optimizer the device
+uses runs on cpu-context NDArrays of the touched rows — exactly the
+reference's server-side-optimizer contract, without server processes.
+
+Multi-host note: each worker process owns the full service for its own
+tables in this build (BASELINE config 4 is single-host); sharding rows
+across hosts would reuse this class per-host with a row->host hash and the
+existing jax.distributed rendezvous — the shard layout is already
+host-count-agnostic.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["SparsePS"]
+
+
+class _Table:
+    __slots__ = ("value", "lock", "state")
+
+    def __init__(self, value):
+        self.value = value          # numpy (rows, *cols) — host RAM
+        self.lock = threading.Lock()
+        self.state = {}             # optimizer state rows, created lazily
+
+
+class SparsePS:
+    """The host KV service: init/push/row_sparse_pull + server-side opt."""
+
+    def __init__(self, num_shards=4):
+        # shards bound row-id ranges for lock granularity (the reference
+        # server key-ranges role); single host ⇒ logical shards
+        self.num_shards = int(num_shards)
+        self._tables = {}
+        self._optimizer = None
+        self._updaters = {}
+
+    # -- registration -------------------------------------------------------
+    def init(self, key, value):
+        if key in self._tables:
+            raise MXNetError(f"sparse key {key!r} already initialized")
+        from ..ndarray import sparse as sp
+        if isinstance(value, sp.RowSparseNDArray):
+            dense = value.tostype("default").asnumpy()
+        else:
+            dense = value.asnumpy()
+        self._tables[key] = _Table(_np.array(dense, copy=True))
+
+    def keys(self):
+        return sorted(self._tables)
+
+    def shape(self, key):
+        return self._tables[key].value.shape
+
+    def set_optimizer(self, optimizer):
+        """Server-side optimizer (reference kvstore.set_optimizer →
+        server runs the updater)."""
+        self._optimizer = optimizer
+        self._updaters = {}
+
+    # -- traffic ------------------------------------------------------------
+    def push(self, key, grad):
+        """Apply a row-sparse gradient to the table, lazily (touched rows
+        only — reference row_sparse sgd_update semantics)."""
+        from .. import optimizer as opt
+        from .. import ndarray as nd
+        from ..ndarray import sparse as sp
+        tbl = self._tables.get(key)
+        if tbl is None:
+            raise MXNetError(f"sparse key {key!r} not initialized")
+        if isinstance(grad, sp.RowSparseNDArray):
+            rows = _np.asarray(grad.indices.asnumpy(), _np.int64)
+            vals = _np.asarray(grad.data.asnumpy())
+        else:
+            rows = _np.arange(tbl.value.shape[0])
+            vals = grad.asnumpy()
+        if rows.size == 0:
+            return
+        # aggregate duplicate rows (reference merge buffer)
+        uniq, inv = _np.unique(rows, return_inverse=True)
+        merged = _np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+        _np.add.at(merged, inv, vals)
+        with tbl.lock:
+            if self._optimizer is None:
+                tbl.value[uniq] += merged  # raw accumulate (no updater)
+                return
+            upd = self._updaters.get(key)
+            if upd is None:
+                upd = opt.get_updater(self._optimizer)
+                self._updaters[key] = upd
+            # run the SAME python optimizer on the touched row block
+            # (cpu-context NDArrays — the server-side CPU update)
+            w = nd.array(tbl.value[uniq])
+            g = nd.array(merged)
+            self._ensure_row_states(tbl, key, uniq, w)
+            upd.states[key] = self._gather_states(tbl, uniq)
+            upd(key, g, w)
+            self._scatter_states(tbl, uniq, upd.states[key])
+            tbl.value[uniq] = w.asnumpy()
+
+    # optimizer state per ROW lives host-side too, gathered/scattered
+    # around each update so adaptive optimizers (adagrad/adam) stay lazy
+    def _ensure_row_states(self, tbl, key, rows, w_block):
+        if "proto" not in tbl.state:
+            proto = self._optimizer.create_state_multi_precision(
+                key, w_block[:1])
+            tbl.state["proto"] = _state_shapes(proto)
+            tbl.state["rows"] = {}
+
+    def _gather_states(self, tbl, rows):
+        from .. import ndarray as nd
+        proto = tbl.state["proto"]
+        store = tbl.state["rows"]
+        return _state_build(proto, rows, store, nd)
+
+    def _scatter_states(self, tbl, rows, states):
+        store = tbl.state["rows"]
+        _state_store(states, rows, store)
+
+    def row_sparse_pull(self, key, row_ids):
+        """Gather the requested rows → RowSparseNDArray on device."""
+        from .. import ndarray as nd
+        from ..ndarray import sparse as sp
+        tbl = self._tables.get(key)
+        if tbl is None:
+            raise MXNetError(f"sparse key {key!r} not initialized")
+        rows = _np.unique(_np.asarray(row_ids.asnumpy(), _np.int64))
+        with tbl.lock:
+            block = tbl.value[rows]
+        return sp.RowSparseNDArray(
+            nd.array(block), nd.array(rows), tbl.value.shape)
+
+    def pull_dense(self, key):
+        from .. import ndarray as nd
+        tbl = self._tables[key]
+        with tbl.lock:
+            return nd.array(tbl.value.copy())
+
+
+# -- per-row optimizer-state plumbing ---------------------------------------
+
+class _Leaf:
+    """Template of one state leaf for ONE row (shape minus the row dim)."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = shape
+        self.dtype = dtype
+
+
+def _state_shapes(proto):
+    if proto is None:
+        return None
+    if isinstance(proto, (list, tuple)):
+        return type(proto)(_state_shapes(s) for s in proto)
+    return _Leaf(tuple(proto.shape[1:]), str(_np.dtype(proto.dtype)))
+
+
+def _state_build(proto, rows, store, nd):
+    """NDArray state blocks for these rows (zeros where never touched)."""
+    if proto is None:
+        return None
+    if isinstance(proto, (list, tuple)):
+        return type(proto)(_state_build(p, rows, store.setdefault(i, {}), nd)
+                           for i, p in enumerate(proto))
+    block = _np.zeros((len(rows),) + proto.shape, proto.dtype)
+    for j, r in enumerate(rows):
+        if r in store:
+            block[j] = store[r]
+    return nd.array(block)
+
+
+def _state_store(states, rows, store):
+    if states is None:
+        return
+    if isinstance(states, (list, tuple)):
+        for i, s in enumerate(states):
+            _state_store(s, rows, store.setdefault(i, {}))
+        return
+    vals = states.asnumpy()
+    for j, r in enumerate(rows):
+        store[r] = vals[j]
