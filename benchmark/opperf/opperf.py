@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""opperf — per-operator forward/backward micro-benchmark harness
+(reference benchmark/opperf/opperf.py + utils/op_registry_utils.py, P23).
+
+Auto-discovers operators from the registry (so coverage tracks op
+additions, SURVEY §4.2), times forward — and backward through autograd
+for differentiable ops — and emits JSON (one row per op) or markdown.
+
+Input synthesis: ops declare nothing, so inputs come from a family map
+(unary/binary/matmul/reduce/nn/...) plus per-op overrides; ops the
+synthesizer can't satisfy are reported as skipped rather than silently
+dropped (no silent caps).
+
+Usage:
+  python benchmark/opperf/opperf.py --ops dot,softmax,Convolution
+  python benchmark/opperf/opperf.py --all --output md
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+_N = 64  # canonical square dim
+
+
+def _inputs_for(name, mx):
+    """Return (positional NDArrays, attrs) for an op, or None."""
+    nd = mx.nd
+    r = np.random.RandomState(0)
+
+    def t(*shape):
+        return nd.array(r.randn(*shape).astype(np.float32))
+
+    overrides = {
+        "dot": ([t(_N, _N), t(_N, _N)], {}),
+        "batch_dot": ([t(8, _N, _N), t(8, _N, _N)], {}),
+        "matmul": ([t(_N, _N), t(_N, _N)], {}),
+        "FullyConnected": ([t(_N, _N), t(128, _N), t(128)],
+                           {"num_hidden": 128}),
+        "Convolution": ([t(8, 16, 32, 32), t(32, 16, 3, 3)],
+                        {"kernel": (3, 3), "num_filter": 32, "pad": (1, 1),
+                         "no_bias": True}),
+        "Pooling": ([t(8, 16, 32, 32)], {"kernel": (2, 2), "stride": (2, 2),
+                                         "pool_type": "max"}),
+        "BatchNorm": ([t(8, 16, 16, 16), t(16), t(16), t(16), t(16)], {}),
+        "LayerNorm": ([t(_N, _N), t(_N), t(_N)], {}),
+        "softmax": ([t(_N, _N)], {}),
+        "log_softmax": ([t(_N, _N)], {}),
+        "softmax_cross_entropy": (
+            [t(_N, 10), nd.array(r.randint(0, 10, (_N,)))], {}),
+        "take": ([t(_N, _N), nd.array(r.randint(0, _N, (32,)))], {}),
+        "Embedding": ([nd.array(r.randint(0, 100, (32,))), t(100, 16)],
+                      {"input_dim": 100, "output_dim": 16}),
+        "concat": ([t(_N, _N), t(_N, _N)], {"dim": 1}),
+        "where": ([nd.array(r.rand(_N, _N) > 0.5), t(_N, _N), t(_N, _N)],
+                  {}),
+        "topk": ([t(_N, _N)], {"k": 5, "ret_typ": "value"}),
+        "transpose": ([t(_N, _N)], {}),
+        "sum": ([t(_N, _N)], {}),
+        "mean": ([t(_N, _N)], {}),
+        "norm": ([t(_N, _N)], {}),
+        "reshape": ([t(_N, _N)], {"shape": (_N * _N,)}),
+    }
+    if name in overrides:
+        return overrides[name]
+    # generic families: try unary then binary on a square tensor
+    return None
+
+
+def bench_op(name, mx, warmup=2, runs=10, with_backward=True):
+    from mxnet_tpu.ops import registry
+    from mxnet_tpu import autograd
+    op = registry.get(name)
+    spec = _inputs_for(name, mx)
+    if spec is None:
+        r = np.random.RandomState(0)
+        x = mx.nd.array(np.abs(r.randn(_N, _N)).astype(np.float32) + 0.5)
+        for args in ([x], [x, x]):
+            try:
+                registry.invoke(op, args, {})
+                spec = (args, {})
+                break
+            except Exception:
+                continue
+        if spec is None:
+            return {"op": name, "skipped": "no input synthesizer"}
+    args, attrs = spec
+
+    def fwd():
+        out = registry.invoke(op, args, dict(attrs))
+        outs = out if isinstance(out, list) else [out]
+        outs[0].wait_to_read()
+        return outs
+
+    try:
+        for _ in range(warmup):
+            fwd()
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            fwd()
+        fwd_ms = (time.perf_counter() - t0) / runs * 1e3
+    except Exception as e:  # noqa: BLE001 — report, don't die mid-sweep
+        return {"op": name, "skipped": f"fwd error: {type(e).__name__}"}
+
+    row = {"op": name, "fwd_ms": round(fwd_ms, 4)}
+    if with_backward and op.differentiable:
+        try:
+            grads_ok = [a for a in args
+                        if np.dtype(a.dtype).kind == "f"]
+            for a in grads_ok:
+                a.attach_grad()
+
+            def bwd():
+                with autograd.record():
+                    out = registry.invoke(op, args, dict(attrs))
+                    outs = out if isinstance(out, list) else [out]
+                    head = outs[0]
+                loss = head if head.ndim == 0 else (head * head).sum()
+                loss.backward()
+                grads_ok[0].grad.wait_to_read()
+
+            for _ in range(warmup):
+                bwd()
+            t0 = time.perf_counter()
+            for _ in range(runs):
+                bwd()
+            row["fwd_bwd_ms"] = round(
+                (time.perf_counter() - t0) / runs * 1e3, 4)
+        except Exception as e:  # noqa: BLE001
+            row["bwd_skipped"] = type(e).__name__
+    return row
+
+
+def run(ops=None, output="json", warmup=2, runs=10):
+    import mxnet_tpu as mx
+    from mxnet_tpu.ops import registry
+    names = ops if ops else [n for n in registry.list_ops()
+                             if not n.startswith("_")]
+    rows = [bench_op(n, mx, warmup, runs) for n in names]
+    if output == "md":
+        print("| op | fwd ms | fwd+bwd ms | note |")
+        print("|---|---|---|---|")
+        for r in rows:
+            print(f"| {r['op']} | {r.get('fwd_ms', '')} | "
+                  f"{r.get('fwd_bwd_ms', '')} | "
+                  f"{r.get('skipped', r.get('bwd_skipped', ''))} |")
+    else:
+        for r in rows:
+            print(json.dumps(r))
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated op names (default: a curated set)")
+    ap.add_argument("--all", action="store_true",
+                    help="sweep every registered op")
+    ap.add_argument("--output", choices=["json", "md"], default="json")
+    ap.add_argument("--runs", type=int, default=10)
+    args = ap.parse_args(argv)
+    if args.all:
+        ops = None
+    elif args.ops:
+        ops = args.ops.split(",")
+    else:
+        ops = ["dot", "batch_dot", "FullyConnected", "Convolution",
+               "softmax", "LayerNorm", "BatchNorm", "sum", "take",
+               "Embedding", "relu", "exp", "broadcast_add", "transpose"]
+    run(ops, args.output, runs=args.runs)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,7 +79,7 @@ def __getattr__(name):
               "io", "image", "kvstore", "profiler", "runtime", "symbol",
               "parallel", "test_utils", "recordio", "callback", "model",
               "util", "numpy", "numpy_extension", "contrib", "amp", "module",
-              "monitor", "checkpoint", "dmlc_params"}
+              "monitor", "checkpoint", "dmlc_params", "operator"}
     if name in lazies:
         mod = _lazy(name)
         globals()[name] = mod

@@ -1,0 +1,7 @@
+"""gluon.contrib.estimator (reference gluon/contrib/estimator/, P10)."""
+
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,  # noqa: F401
+                            BatchBegin, BatchEnd, StoppingHandler,
+                            LoggingHandler, CheckpointHandler,
+                            EarlyStoppingHandler, ValidationHandler)

@@ -10,3 +10,5 @@ numerics oracle.
 """
 
 from .flash_attention import flash_attention  # noqa: F401
+from .ring_attention import (ring_attention,  # noqa: F401
+                             sequence_parallel_attention)

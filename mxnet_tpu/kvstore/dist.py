@@ -81,9 +81,16 @@ class KVStoreDistTPUSync(KVStoreLocal):
         if isinstance(key, (list, tuple)) and len(key) == 1:
             key = key[0]
         if self._is_sparse_key(key):
+            from ..ndarray import sparse as sp
             dense = self._ps().pull_dense(key)
             outs = out if isinstance(out, (list, tuple)) else [out]
             for o in outs:
+                if isinstance(o, sp.BaseSparseNDArray):
+                    if ignore_sparse:
+                        continue  # reference: sparse outs skipped here
+                    raise MXNetError(
+                        "pull of a sparse-PS key into a sparse out is not "
+                        "supported; use row_sparse_pull(key, row_ids=...)")
                 o._set_data(dense.as_in_context(o.ctx)._data)
             return
         return super().pull(key, out=out, priority=priority,

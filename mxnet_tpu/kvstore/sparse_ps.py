@@ -1,6 +1,6 @@
-"""Host-resident sharded KV service for row-sparse parameters — the
-surviving parameter-server role (SURVEY §5.8/§7.1: "PS semantics retained
-ONLY for sparse embeddings").
+"""Host-resident KV service for row-sparse parameters — the surviving
+parameter-server role (SURVEY §5.8/§7.1: "PS semantics retained ONLY for
+sparse embeddings").
 
 Reference: ``src/kvstore/kvstore_dist_server.h`` (N14: the server stores
 the table, aggregates sparse grads, runs the optimizer server-side) +
@@ -9,17 +9,21 @@ semantics of ``src/operator/optimizer_op.cc`` (row_sparse sgd/adagrad:
 ONLY touched rows advance).
 
 TPU-native shape: embedding tables too big for HBM stay in host RAM as
-numpy shards (row-hashed over ``num_shards``); the training step pulls
-just the rows a batch touches (``row_sparse_pull``) onto the device, and
-pushes row-sparse grads back, where the SAME python optimizer the device
-uses runs on cpu-context NDArrays of the touched rows — exactly the
-reference's server-side-optimizer contract, without server processes.
+numpy arrays; the training step pulls just the rows a batch touches
+(``row_sparse_pull``) onto the device, and pushes row-sparse grads back,
+where the SAME python optimizer the device uses runs on cpu-context
+NDArrays of the touched rows — the reference's server-side-optimizer
+contract without server processes.  Optimizer state lives host-side as
+full-table numpy arrays (what the reference server holds), gathered and
+scattered by vectorized fancy indexing; rows are state-initialized on
+first touch via ``create_state_multi_precision`` on their current values
+(so e.g. fp32 master-weight leaves start at the row values, momenta at
+their true initial state — never blind zeros).
 
 Multi-host note: each worker process owns the full service for its own
 tables in this build (BASELINE config 4 is single-host); sharding rows
-across hosts would reuse this class per-host with a row->host hash and the
-existing jax.distributed rendezvous — the shard layout is already
-host-count-agnostic.
+across hosts would run one service per host behind a row->host hash over
+the existing jax.distributed rendezvous.
 """
 
 from __future__ import annotations
@@ -34,24 +38,26 @@ __all__ = ["SparsePS"]
 
 
 class _Table:
-    __slots__ = ("value", "lock", "state")
+    __slots__ = ("value", "lock", "state_leaves", "state_inited")
 
     def __init__(self, value):
         self.value = value          # numpy (rows, *cols) — host RAM
         self.lock = threading.Lock()
-        self.state = {}             # optimizer state rows, created lazily
+        # full-table optimizer state: list of dense numpy arrays (one per
+        # state leaf, row-major like value) + per-row inited mask; tree
+        # structure is recorded in SparsePS._state_tree
+        self.state_leaves = None
+        self.state_inited = None
 
 
 class SparsePS:
     """The host KV service: init/push/row_sparse_pull + server-side opt."""
 
-    def __init__(self, num_shards=4):
-        # shards bound row-id ranges for lock granularity (the reference
-        # server key-ranges role); single host ⇒ logical shards
-        self.num_shards = int(num_shards)
+    def __init__(self):
         self._tables = {}
         self._optimizer = None
         self._updaters = {}
+        self._state_tree = {}  # key -> structure template (see _tree_of)
 
     # -- registration -------------------------------------------------------
     def init(self, key, value):
@@ -72,9 +78,15 @@ class SparsePS:
 
     def set_optimizer(self, optimizer):
         """Server-side optimizer (reference kvstore.set_optimizer →
-        server runs the updater)."""
+        server runs the updater).  Switching optimizers resets ALL
+        per-row state (stale momenta must not feed the new update rule)."""
         self._optimizer = optimizer
         self._updaters = {}
+        self._state_tree = {}
+        for tbl in self._tables.values():
+            with tbl.lock:
+                tbl.state_leaves = None
+                tbl.state_inited = None
 
     # -- traffic ------------------------------------------------------------
     def push(self, key, grad):
@@ -106,34 +118,45 @@ class SparsePS:
             if upd is None:
                 upd = opt.get_updater(self._optimizer)
                 self._updaters[key] = upd
-            # run the SAME python optimizer on the touched row block
-            # (cpu-context NDArrays — the server-side CPU update)
             w = nd.array(tbl.value[uniq])
             g = nd.array(merged)
-            self._ensure_row_states(tbl, key, uniq, w)
-            upd.states[key] = self._gather_states(tbl, uniq)
+            self._ensure_states(tbl, key, uniq, w)
+            upd.states[key] = self._gather_states(tbl, key, uniq)
             upd(key, g, w)
-            self._scatter_states(tbl, uniq, upd.states[key])
+            self._scatter_states(tbl, key, uniq, upd.states[key])
             tbl.value[uniq] = w.asnumpy()
 
-    # optimizer state per ROW lives host-side too, gathered/scattered
-    # around each update so adaptive optimizers (adagrad/adam) stay lazy
-    def _ensure_row_states(self, tbl, key, rows, w_block):
-        if "proto" not in tbl.state:
+    # -- per-row optimizer state (dense host arrays, vectorized IO) ---------
+    def _ensure_states(self, tbl, key, rows, w_block):
+        """Allocate dense state arrays once; state-init first-touch rows by
+        running create_state on their CURRENT values."""
+        from .. import ndarray as nd
+        if key not in self._state_tree:
             proto = self._optimizer.create_state_multi_precision(
                 key, w_block[:1])
-            tbl.state["proto"] = _state_shapes(proto)
-            tbl.state["rows"] = {}
+            self._state_tree[key] = _tree_of(proto)
+            leaves = _leaves_of(proto)
+            n_rows = tbl.value.shape[0]
+            tbl.state_leaves = [
+                _np.zeros((n_rows,) + tuple(lf.shape[1:]),
+                          _np.dtype(lf.dtype)) for lf in leaves]
+            tbl.state_inited = _np.zeros(n_rows, bool)
+        fresh = rows[~tbl.state_inited[rows]]
+        if fresh.size:
+            init_state = self._optimizer.create_state_multi_precision(
+                key, nd.array(tbl.value[fresh]))
+            for dst, lf in zip(tbl.state_leaves, _leaves_of(init_state)):
+                dst[fresh] = lf.asnumpy()
+            tbl.state_inited[fresh] = True
 
-    def _gather_states(self, tbl, rows):
+    def _gather_states(self, tbl, key, rows):
         from .. import ndarray as nd
-        proto = tbl.state["proto"]
-        store = tbl.state["rows"]
-        return _state_build(proto, rows, store, nd)
+        blocks = [nd.array(leaf[rows]) for leaf in tbl.state_leaves]
+        return _tree_build(self._state_tree[key], iter(blocks))
 
-    def _scatter_states(self, tbl, rows, states):
-        store = tbl.state["rows"]
-        _state_store(states, rows, store)
+    def _scatter_states(self, tbl, key, rows, states):
+        for leaf_arr, nd_leaf in zip(tbl.state_leaves, _leaves_of(states)):
+            leaf_arr[rows] = nd_leaf.asnumpy()
 
     def row_sparse_pull(self, key, row_ids):
         """Gather the requested rows → RowSparseNDArray on device."""
@@ -155,47 +178,34 @@ class SparsePS:
             return nd.array(tbl.value.copy())
 
 
-# -- per-row optimizer-state plumbing ---------------------------------------
+# -- state-tree helpers ------------------------------------------------------
+# a state is None | NDArray | (nested) tuple/list of those; leaves are
+# enumerated left-to-right so dense arrays and trees stay aligned
 
-class _Leaf:
-    """Template of one state leaf for ONE row (shape minus the row dim)."""
+def _leaves_of(state):
+    if state is None:
+        return []
+    if isinstance(state, (list, tuple)):
+        out = []
+        for s in state:
+            out.extend(_leaves_of(s))
+        return out
+    return [state]
 
-    __slots__ = ("shape", "dtype")
 
-    def __init__(self, shape, dtype):
-        self.shape = shape
-        self.dtype = dtype
-
-
-def _state_shapes(proto):
-    if proto is None:
+def _tree_of(state):
+    """Structure template: None | 'leaf' | (type, [templates])."""
+    if state is None:
         return None
-    if isinstance(proto, (list, tuple)):
-        return type(proto)(_state_shapes(s) for s in proto)
-    return _Leaf(tuple(proto.shape[1:]), str(_np.dtype(proto.dtype)))
+    if isinstance(state, (list, tuple)):
+        return (type(state), [_tree_of(s) for s in state])
+    return "leaf"
 
 
-def _state_build(proto, rows, store, nd):
-    """NDArray state blocks for these rows (zeros where never touched)."""
-    if proto is None:
+def _tree_build(tmpl, leaf_iter):
+    if tmpl is None:
         return None
-    if isinstance(proto, (list, tuple)):
-        return type(proto)(_state_build(p, rows, store.setdefault(i, {}), nd)
-                           for i, p in enumerate(proto))
-    block = _np.zeros((len(rows),) + proto.shape, proto.dtype)
-    for j, r in enumerate(rows):
-        if r in store:
-            block[j] = store[r]
-    return nd.array(block)
-
-
-def _state_store(states, rows, store):
-    if states is None:
-        return
-    if isinstance(states, (list, tuple)):
-        for i, s in enumerate(states):
-            _state_store(s, rows, store.setdefault(i, {}))
-        return
-    vals = states.asnumpy()
-    for j, r in enumerate(rows):
-        store[r] = vals[j]
+    if tmpl == "leaf":
+        return next(leaf_iter)
+    t, subs = tmpl
+    return t(_tree_build(s, leaf_iter) for s in subs)

@@ -19,3 +19,15 @@ from . import sparse  # noqa: F401,E402
 def imresize(*args, **kwargs):
     from ..image import imresize as _f
     return _f(*args, **kwargs)
+
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """User-registered python op (reference mx.nd.Custom → custom.cc).
+
+    See mxnet_tpu.operator for the CustomOp/CustomOpProp registration
+    surface; under autograd the op's ``backward`` is the vjp."""
+    from ..base import MXNetError
+    if op_type is None:
+        raise MXNetError("nd.Custom requires op_type=")
+    from .. import operator as _op
+    return _op.invoke_custom(list(inputs), op_type, **kwargs)

@@ -15,6 +15,7 @@ semantics (e.g. zero-dim shapes are always supported).
 
 from __future__ import annotations
 
+import builtins as _builtins  # this module shadows any/all/min/max/sum
 import sys as _sys
 import types as _types
 
@@ -43,6 +44,14 @@ newaxis = None
 
 
 def array(object, dtype=None, ctx=None):
+    """np-semantics dtype inference: ints stay integral (reference mx.np
+    keeps int64 for python ints, float32 for python floats).  Sources
+    that carry an explicit numpy dtype keep it — downcasting a float64
+    ndarray would silently lose precision (x64 mode is on)."""
+    if dtype is None and not isinstance(object, NDArray) \
+            and not hasattr(object, "dtype"):
+        inferred = _onp.asarray(object).dtype
+        dtype = _onp.float32 if inferred.kind == "f" else inferred
     return _nd_array(object, ctx=ctx, dtype=dtype)
 
 
@@ -60,14 +69,26 @@ def _wrap_jnp(name, jfn):
         _reg._REGISTRY[opname] = op
 
     def fn(*args, **kwargs):
+        # NDArrays may arrive bare or inside a list/tuple (np.concatenate
+        # etc.) — collect them as op inputs and rebuild the call spec
         inputs = []
-        conv_args = []
+        spec = []
         for a in args:
             if isinstance(a, NDArray):
+                spec.append(("arr", None))
                 inputs.append(a)
-                conv_args.append(None)  # placeholder
+            elif isinstance(a, (list, tuple)) and \
+                    _builtins.any(isinstance(x, NDArray) for x in a):
+                sub = []
+                for x in a:
+                    if isinstance(x, NDArray):
+                        sub.append(None)
+                        inputs.append(x)
+                    else:
+                        sub.append(x)
+                spec.append(("seq", (type(a), sub)))
             else:
-                conv_args.append(a)
+                spec.append(("lit", a))
         if not inputs:
             import jax.numpy as jnp
             out = jfn(*args, **kwargs)
@@ -79,7 +100,15 @@ def _wrap_jnp(name, jfn):
         # positional args are bound via a closure attr
         def bound(*arrs, _kw=tuple(sorted(kwargs.items()))):
             it = iter(arrs)
-            full = [next(it) if c is None else c for c in conv_args]
+            full = []
+            for kind, payload in spec:
+                if kind == "arr":
+                    full.append(next(it))
+                elif kind == "lit":
+                    full.append(payload)
+                else:
+                    t, sub = payload
+                    full.append(t(next(it) if s is None else s for s in sub))
             return jfn(*full, **dict(_kw))
         call_op = _reg.Op(opname, bound, num_outputs=-1, jit=False)
         res = _reg.invoke(call_op, inputs, {})

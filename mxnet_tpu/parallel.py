@@ -36,7 +36,21 @@ from . import ndarray as nd
 from .ndarray.ndarray import NDArray
 
 __all__ = ["DeviceMesh", "make_mesh", "data_parallel_ctxs", "TrainStep",
-           "allreduce", "allgather", "current_mesh", "set_mesh"]
+           "allreduce", "allgather", "current_mesh", "set_mesh",
+           "attention", "ring_attention"]
+
+
+def __getattr__(name):
+    # sequence-parallel attention (SURVEY §5.7): lazily re-exported so
+    # importing parallel doesn't pull the kernels package
+    if name in ("attention", "ring_attention"):
+        from .kernels.ring_attention import (ring_attention,
+                                             sequence_parallel_attention)
+        val = sequence_parallel_attention if name == "attention" \
+            else ring_attention
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module 'mxnet_tpu.parallel' has no attribute {name!r}")
 
 
 def _jax():

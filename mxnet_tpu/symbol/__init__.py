@@ -4,6 +4,7 @@ import sys as _sys
 
 from .symbol import (  # noqa: F401
     Symbol, var, Variable, Group, load, load_json, zeros, ones,
+    register_backend,
 )
 from . import register as _register
 

@@ -27,7 +27,29 @@ from ..ndarray.ndarray import NDArray
 from ..ops import registry as _reg
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
-           "zeros", "ones"]
+           "zeros", "ones", "register_backend"]
+
+# Subgraph-backend registry (reference SubgraphBackendRegistry, N9):
+# name -> pass fn(symbol, args, aux, **kwargs) -> symbol
+_BACKEND_REGISTRY: dict = {}
+
+
+def register_backend(name):
+    """Register a graph-rewrite backend for ``sym.optimize_for(name)``."""
+    def deco(fn):
+        _BACKEND_REGISTRY[str(name)] = fn
+        return fn
+    return deco
+
+
+def _xla_identity_pass(sym, args=None, aux=None, **kwargs):  # noqa: ARG001
+    # fusion/memory-planning/layout are XLA compiler passes on this stack;
+    # the partitioner has nothing to carve out (SURVEY §7.1 N8/N9 rows)
+    return sym
+
+
+for _n in ("default", "TPU", "xla"):
+    _BACKEND_REGISTRY[_n] = _xla_identity_pass
 
 
 class Symbol:
@@ -343,10 +365,27 @@ class Symbol:
                for a, s in zip(self.list_auxiliary_states(), aux_shapes)}
         return self.bind(ctx, args, args_grad, grad_req, aux)
 
-    def optimize_for(self, backend, **kwargs):  # noqa: ARG002
-        """Graph-rewrite entry (reference MXOptimizeForBackend/N9).  XLA is
-        the single backend; returns self."""
-        return self
+    def optimize_for(self, backend, args=None, aux=None, **kwargs):
+        """Graph-rewrite entry (reference sym.optimize_for →
+        MXOptimizeForBackend + SubgraphBackendRegistry, N9/N6).
+
+        Backends are python passes ``fn(symbol, args, aux, **kwargs) ->
+        symbol`` registered via ``register_backend``.  Built-ins:
+        'default'/'TPU'/'xla' — identity with rationale (operator fusion,
+        memory planning and layout belong to XLA's compiler passes here,
+        so there is nothing left for a hand-rolled partitioner to do).
+        Unknown backends RAISE (the reference errors for unregistered
+        backends too; silently returning self would hide missing
+        MKLDNN/TensorRT-style integrations).
+        """
+        fn = _BACKEND_REGISTRY.get(str(backend))
+        if fn is None:
+            from ..base import MXNetError
+            raise MXNetError(
+                f"subgraph backend {backend!r} is not registered "
+                f"(known: {sorted(_BACKEND_REGISTRY)}); register one with "
+                "mxnet_tpu.symbol.register_backend(name)(pass_fn)")
+        return fn(self, args, aux, **kwargs)
 
     # -- serialization -------------------------------------------------------
     def tojson(self):

@@ -1,0 +1,139 @@
+"""mx.np semantics tests (reference tests/python/unittest/test_numpy_op.py
+/ test_numpy_ndarray.py patterns, P3)."""
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+np = mx.np
+npx = mx.npx
+
+
+def test_array_roundtrip_and_zero_dim():
+    a = np.array(3.5)
+    assert a.shape == ()
+    assert float(a.asnumpy()) == 3.5
+    b = np.array([[1, 2], [3, 4]], dtype=np.float32)
+    onp.testing.assert_array_equal(b.asnumpy(), [[1, 2], [3, 4]])
+
+
+@pytest.mark.parametrize("name,args", [
+    ("zeros", ((2, 3),)), ("ones", ((4,),)), ("eye", (3,)),
+    ("arange", (5,)), ("linspace", (0.0, 1.0, 5)),
+])
+def test_creation_matches_numpy(name, args):
+    got = getattr(np, name)(*args).asnumpy()
+    want = getattr(onp, name)(*args)
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["exp", "log1p", "sqrt", "tanh", "floor",
+                                  "sign", "square"])
+def test_unary_matches_numpy(name, seeded):
+    x = onp.abs(onp.random.RandomState(0).randn(3, 4)).astype(onp.float32)
+    got = getattr(np, name)(np.array(x)).asnumpy()
+    onp.testing.assert_allclose(got, getattr(onp, name)(x), rtol=1e-5)
+
+
+def test_broadcasting_and_promotion():
+    a = np.array(onp.ones((3, 1), onp.float32))
+    b = np.array(onp.arange(4, dtype=onp.float32))
+    out = np.add(a, b)
+    assert out.shape == (3, 4)
+    # int + float promotes to float (numpy semantics via jnp)
+    c = np.array(onp.array([1, 2], onp.int32))
+    d = np.array(onp.array([0.5, 0.5], onp.float32))
+    assert onp.dtype(np.add(c, d).dtype).kind == "f"
+
+
+def test_reductions_and_axis_tuples(seeded):
+    x = onp.random.RandomState(1).randn(2, 3, 4).astype(onp.float32)
+    a = np.array(x)
+    onp.testing.assert_allclose(np.sum(a, axis=(0, 2)).asnumpy(),
+                                x.sum(axis=(0, 2)), rtol=1e-5)
+    onp.testing.assert_allclose(np.mean(a).asnumpy(), x.mean(), rtol=1e-5)
+    assert np.argmax(a).asnumpy() == x.argmax()
+
+
+def test_einsum_matmul(seeded):
+    r = onp.random.RandomState(2)
+    A = r.randn(3, 4).astype(onp.float32)
+    B = r.randn(4, 5).astype(onp.float32)
+    onp.testing.assert_allclose(np.matmul(np.array(A), np.array(B)).asnumpy(),
+                                A @ B, rtol=1e-5)
+    onp.testing.assert_allclose(
+        np.einsum("ij,jk->ik", np.array(A), np.array(B)).asnumpy(),
+        A @ B, rtol=1e-5)
+
+
+def test_linalg_namespace(seeded):
+    r = onp.random.RandomState(3)
+    M = r.randn(4, 4).astype(onp.float32)
+    M = M @ M.T + 4 * onp.eye(4, dtype=onp.float32)  # SPD
+    a = np.array(M)
+    onp.testing.assert_allclose(np.linalg.det(a).asnumpy(),
+                                onp.linalg.det(M), rtol=1e-3)
+    onp.testing.assert_allclose(
+        (np.linalg.inv(a).asnumpy() @ M), onp.eye(4), atol=1e-4)
+    L = np.linalg.cholesky(a).asnumpy()
+    onp.testing.assert_allclose(L @ L.T, M, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(np.linalg.norm(a).asnumpy(),
+                                onp.linalg.norm(M), rtol=1e-5)
+    q, rr = np.linalg.qr(a)
+    onp.testing.assert_allclose(q.asnumpy() @ rr.asnumpy(), M, rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_random_namespace_shapes_and_stats():
+    mx.random.seed(0)
+    u = np.random.uniform(0.0, 1.0, size=(2000,))
+    assert u.shape == (2000,)
+    assert 0.0 <= float(u.asnumpy().min()) and float(u.asnumpy().max()) <= 1.0
+    assert abs(float(u.asnumpy().mean()) - 0.5) < 0.05
+    n = np.random.normal(2.0, 0.5, size=(2000,))
+    assert abs(float(n.asnumpy().mean()) - 2.0) < 0.1
+    r = np.random.randint(0, 7, size=(100,))
+    vals = r.asnumpy()
+    assert vals.min() >= 0 and vals.max() < 7
+    # seeded reproducibility
+    mx.random.seed(42)
+    a = np.random.normal(size=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = np.random.normal(size=(5,)).asnumpy()
+    onp.testing.assert_array_equal(a, b)
+
+
+def test_autograd_through_np_ops(seeded):
+    x = np.array(onp.array([1.0, 2.0, 3.0], onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = np.sum(np.square(x) * 2.0)
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [4.0, 8.0, 12.0])
+
+
+def test_np_indexing_and_manip(seeded):
+    x = onp.arange(24, dtype=onp.float32).reshape(2, 3, 4)
+    a = np.array(x)
+    onp.testing.assert_array_equal(
+        np.transpose(a, (2, 0, 1)).asnumpy(), x.transpose(2, 0, 1))
+    onp.testing.assert_array_equal(
+        np.concatenate([a, a], axis=1).asnumpy(),
+        onp.concatenate([x, x], axis=1))
+    onp.testing.assert_array_equal(np.where(a > 10, a, 0 * a).asnumpy(),
+                                   onp.where(x > 10, x, 0))
+    onp.testing.assert_array_equal(np.take(a.reshape(-1),
+                                           np.array([0, 5, 7])).asnumpy(),
+                                   x.reshape(-1)[[0, 5, 7]])
+
+
+def test_npx_set_np_roundtrip():
+    assert not mx.util.is_np_array()
+    npx.set_np()
+    try:
+        assert mx.util.is_np_array()
+    finally:
+        npx.reset_np()
+    assert not mx.util.is_np_array()

@@ -117,6 +117,49 @@ def test_dist_sparse_list_key_forms():
     assert o2.indices.asnumpy().tolist() == [0, 3]
 
 
+def test_ps_multi_precision_master_weights_init_from_rows():
+    # first-touch state init runs create_state on the CURRENT row values:
+    # an fp32 master-weight leaf must start at the row values, not zeros
+    import ml_dtypes
+    ps = SparsePS()
+    table = np.full((4, 2), 2.0, np.float32).astype(ml_dtypes.bfloat16)
+    ps.init("w", mx.nd.array(table))
+    ps.set_optimizer(mx.optimizer.SGD(learning_rate=0.25, rescale_grad=1.0,
+                                      multi_precision=True))
+    g = RowSparseNDArray(mx.nd.array(np.ones((1, 2), np.float32)
+                                     .astype(ml_dtypes.bfloat16)),
+                         mx.nd.array([1]), (4, 2))
+    ps.push("w", g)
+    dense = ps.pull_dense("w").asnumpy().astype(np.float32)
+    # master starts at 2.0 → 2.0 - 0.25*1 = 1.75 (zero master gives -0.25)
+    np.testing.assert_allclose(dense[1], 1.75)
+    np.testing.assert_allclose(dense[0], 2.0)
+
+
+def test_ps_set_optimizer_resets_state():
+    ps = SparsePS()
+    ps.init("w", mx.nd.zeros((3, 1)))
+    ps.set_optimizer(mx.optimizer.SGD(learning_rate=1.0, momentum=0.9,
+                                      rescale_grad=1.0))
+    g = _rsp([[1.0]], [0], (3, 1))
+    ps.push("w", g)
+    ps.push("w", g)  # momentum now non-zero for row 0
+    ps.set_optimizer(mx.optimizer.AdaGrad(learning_rate=1.0, eps=1e-8))
+    ps.push("w", g)
+    tbl = ps._tables["w"]
+    # adagrad history after ONE push must be g^2, not stale sgd momentum
+    np.testing.assert_allclose(tbl.state_leaves[0][0], 1.0, rtol=1e-6)
+
+
+def test_dist_pull_sparse_out_contract():
+    kv = mx.kv.create("dist_tpu_sync")
+    kv.init("e", cast_storage(mx.nd.ones((4, 2)), "row_sparse"))
+    sparse_out = cast_storage(mx.nd.zeros((4, 2)), "row_sparse")
+    kv.pull("e", sparse_out)  # ignore_sparse default: skipped, no crash
+    with pytest.raises(MXNetError, match="row_sparse_pull"):
+        kv.pull("e", sparse_out, ignore_sparse=False)
+
+
 def test_ps_errors():
     ps = SparsePS()
     with pytest.raises(MXNetError, match="not initialized"):

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""im2rec — pack an image folder (or .lst file) into RecordIO shards
+(reference tools/im2rec.py / im2rec.cc, N27).
+
+Two passes like the reference:
+  1. ``--list``: walk an image root, assign integer labels per
+     subdirectory, write ``prefix.lst`` (``idx\\tlabel\\trelpath`` rows,
+     the reference's tab format).
+  2. default: read ``prefix.lst``, encode each image (cv2 JPEG, falling
+     back to raw PIL bytes when cv2 is unavailable) and append
+     ``IRHeader + payload`` records to ``prefix.rec`` with a
+     ``prefix.idx`` index — the exact byte format
+     ``mx.recordio.MXIndexedRecordIO``/``ImageRecordIter`` consume.
+
+Usage:
+  python tools/im2rec.py --list data/train data/imgs
+  python tools/im2rec.py data/train data/imgs --quality 90
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def make_list(prefix, root, shuffle=True, seed=0):
+    """Pass 1: folder → .lst (label per subdirectory, sorted)."""
+    root = os.path.abspath(root)
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    label_of = {c: i for i, c in enumerate(classes)}
+    rows = []
+    if classes:
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for f in sorted(os.listdir(cdir)):
+                if os.path.splitext(f)[1].lower() in _EXTS:
+                    rows.append((label_of[c], os.path.join(c, f)))
+    else:  # flat folder: label 0
+        for f in sorted(os.listdir(root)):
+            if os.path.splitext(f)[1].lower() in _EXTS:
+                rows.append((0, f))
+    if shuffle:
+        random.Random(seed).shuffle(rows)
+    lst = prefix + ".lst"
+    with open(lst, "w") as f:
+        for i, (label, rel) in enumerate(rows):
+            f.write(f"{i}\t{float(label)}\t{rel}\n")
+    return lst, len(rows), classes
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), float(parts[1]), parts[-1]
+
+
+def _encode(img_path, quality, resize=0):
+    try:
+        import cv2
+        img = cv2.imread(img_path, cv2.IMREAD_COLOR)
+        if img is None:
+            return None
+        if resize:
+            h, w = img.shape[:2]
+            s = resize / min(h, w)
+            img = cv2.resize(img, (int(w * s), int(h * s)))
+        ok, buf = cv2.imencode(".jpg", img,
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+        return buf.tobytes() if ok else None
+    except ImportError:
+        with open(img_path, "rb") as f:
+            return f.read()  # pass through already-encoded bytes
+
+
+def make_rec(prefix, root, quality=95, resize=0):
+    """Pass 2: .lst → .rec/.idx (IRHeader-packed JPEG records)."""
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n, skipped = 0, 0
+    for idx, label, rel in read_list(prefix + ".lst"):
+        payload = _encode(os.path.join(root, rel), quality, resize)
+        if payload is None:
+            skipped += 1
+            continue
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, payload))
+        n += 1
+    rec.close()
+    return n, skipped
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="generate prefix.lst instead of packing")
+    ap.add_argument("--no-shuffle", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter edge to N pixels (0 = keep)")
+    args = ap.parse_args(argv)
+    if args.list:
+        lst, n, classes = make_list(args.prefix, args.root,
+                                    shuffle=not args.no_shuffle)
+        print(f"wrote {lst}: {n} images, {len(classes)} classes")
+        return 0
+    if not os.path.exists(args.prefix + ".lst"):
+        make_list(args.prefix, args.root, shuffle=not args.no_shuffle)
+    n, skipped = make_rec(args.prefix, args.root, args.quality, args.resize)
+    print(f"wrote {args.prefix}.rec: {n} records ({skipped} skipped)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""parse_log — turn training logs into a markdown table (reference
+tools/parse_log.py).
+
+Understands the framework's own log lines (Module.fit
+``Epoch[k] Train-accuracy=…`` / ``Epoch[k] Validation-accuracy=…``,
+estimator ``[Epoch k] … name=value``) and the reference's identical
+Module format.
+
+Usage: python tools/parse_log.py train.log [--format md|csv]
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+_PATTERNS = [
+    # Module.fit / reference: Epoch[3] Train-accuracy=0.91
+    re.compile(r"Epoch\[(?P<epoch>\d+)\]\s+"
+               r"(?P<phase>Train|Validation)-(?P<name>[\w-]+)"
+               r"=(?P<value>[-\d.eE]+)"),
+    # speedometer: Epoch[3] Batch [40] Speed: 123.4 samples/sec
+    re.compile(r"Epoch\[(?P<epoch>\d+)\].*?"
+               r"Speed:\s*(?P<value>[\d.]+)\s*(?P<name>samples)/sec"),
+]
+
+
+_EST_EPOCH = re.compile(r"\[Epoch (?P<epoch>\d+)\]")
+_EST_PAIR = re.compile(r"(?P<name>[\w-]+)=(?P<value>[-\d.eE]+)")
+
+
+def parse(lines):
+    """Returns {epoch: {column: value}} (last value per column wins)."""
+    table = {}
+    for line in lines:
+        matched = False
+        for pat in _PATTERNS:
+            for m in pat.finditer(line):
+                d = m.groupdict()
+                phase = d.get("phase")
+                col = f"{phase.lower()}-{d['name']}" if phase else d["name"]
+                table.setdefault(int(d["epoch"]), {})[col] = \
+                    float(d["value"])
+                matched = True
+        if matched:
+            continue
+        # estimator lines carry SEVERAL name=value pairs — take them all
+        me = _EST_EPOCH.search(line)
+        if me:
+            epoch = int(me.group("epoch"))
+            for m in _EST_PAIR.finditer(line):
+                table.setdefault(epoch, {})[m.group("name")] = \
+                    float(m.group("value"))
+    return table
+
+
+def render(table, fmt="md", out=sys.stdout):
+    cols = sorted({c for row in table.values() for c in row})
+    if fmt == "csv":
+        out.write(",".join(["epoch"] + cols) + "\n")
+        for e in sorted(table):
+            out.write(",".join([str(e)] + [
+                f"{table[e].get(c, '')}" for c in cols]) + "\n")
+        return
+    out.write("| epoch | " + " | ".join(cols) + " |\n")
+    out.write("|" + "---|" * (len(cols) + 1) + "\n")
+    for e in sorted(table):
+        cells = [f"{table[e][c]:g}" if c in table[e] else ""
+                 for c in cols]
+        out.write(f"| {e} | " + " | ".join(cells) + " |\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=["md", "csv"], default="md")
+    args = ap.parse_args(argv)
+    with open(args.logfile) as f:
+        table = parse(f)
+    if not table:
+        print("no recognizable log lines found", file=sys.stderr)
+        return 1
+    render(table, args.format)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
