@@ -61,6 +61,56 @@ def _interleaved_matmul_selfatt_valatt(qkv, att, heads=1):
     return out.reshape(L, B, -1)
 
 
+_PALLAS_PROBE = [None]  # None=unknown, True/False=probed
+
+
+def _pallas_compiles():
+    """One-time probe: can the active TPU toolchain compile a Pallas flash
+    kernel?  The axon remote-compile helper ships its own libtpu whose
+    Mosaic pass pipeline can lag the local jax — when it rejects the
+    kernel IR (verification/legalization errors), every caller must fall
+    back to the dense path instead of crashing the program."""
+    if _PALLAS_PROBE[0] is not None:
+        return _PALLAS_PROBE[0]
+    import jax
+    if jax.default_backend() != "tpu":
+        # platform_dependent picks the dense branch off-TPU anyway; never
+        # attempt a TPU-only kernel compile on cpu/gpu backends
+        _PALLAS_PROBE[0] = True
+        return True
+    try:
+        import numpy as _onp
+        import ml_dtypes
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention, SegmentIds)
+        seg = jax.numpy.ones((2, 128), jax.numpy.int32)
+        # probe the SAME configurations masked_selfatt lowers: segment ids
+        # exercise the index arithmetic that breaks under x64 toolchains,
+        # bf16 lowers differently from f32, the BACKWARD kernels lower on
+        # their own, and B/H > 1 keeps the grid index math from constant-
+        # folding away — forward + grad in both dtypes must all compile
+        for dt in (_onp.float32, ml_dtypes.bfloat16):
+            for causal in (False, True):  # causal uses a different grid
+                x = jax.numpy.asarray(_onp.zeros((2, 2, 128, 64), dt))
+
+                def f(q, k, v, _c=causal):
+                    out = flash_attention(
+                        q, k, v, segment_ids=SegmentIds(seg, seg), causal=_c)
+                    return out.astype(jax.numpy.float32).sum()
+
+                jax.block_until_ready(
+                    jax.grad(f, argnums=(0, 1, 2))(x, x, x))
+        _PALLAS_PROBE[0] = True
+    except Exception as e:  # noqa: BLE001 — any compile failure ⇒ fallback
+        import logging
+        logging.getLogger("mxnet_tpu").warning(
+            "Pallas flash attention unavailable on this TPU toolchain "
+            "(%s: %.120s); using the dense attention fallback",
+            type(e).__name__, str(e))
+        _PALLAS_PROBE[0] = False
+    return _PALLAS_PROBE[0]
+
+
 def _flash_eligible(seq, head_dim):
     """Whether the Pallas TPU flash kernel's tiling applies to these shapes
     (lane-aligned seq blocks); the platform choice itself happens at XLA
@@ -68,7 +118,8 @@ def _flash_eligible(seq, head_dim):
     from .. import config
     if not config.get_int("MXNET_FUSED_ATTENTION", 1):
         return False
-    return seq >= 128 and seq % 128 == 0 and head_dim % 8 == 0
+    return seq >= 128 and seq % 128 == 0 and head_dim % 8 == 0 \
+        and _pallas_compiles()
 
 
 def _dense_sdpa(q, k, v, seg, causal, scale):
