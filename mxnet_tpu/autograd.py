@@ -319,6 +319,13 @@ def _coerce_ct(g, aval):
     implicitly through amp_cast nodes in the grad graph."""
     _, want_dtype = aval
     data = g._data if hasattr(g, "_data") else g
+    import jax
+    import numpy as _onp
+    want = _onp.dtype(want_dtype)
+    want_float = want.kind == "f" or want.name == "bfloat16"
+    if data.dtype == jax.dtypes.float0 or not want_float:
+        # integer-valued primal outputs take float0 cotangents — never cast
+        return g
     if data.dtype != want_dtype:
         cast = data.astype(want_dtype)
         if hasattr(g, "_data"):

@@ -11,9 +11,9 @@ from . import bert  # noqa: F401
 
 def __getattr__(name):
     import importlib
-    if name == "vision":
-        mod = importlib.import_module(".vision", __name__)
-        globals()["vision"] = mod
+    if name in ("vision", "llama"):
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 

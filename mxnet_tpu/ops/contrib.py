@@ -165,6 +165,14 @@ def _masked_selfatt(qkv, valid_length, heads=1, causal=False):
     q = jnp.transpose(q, (1, 2, 0, 3))             # (B, H, L, D)
     k = jnp.transpose(k, (1, 2, 0, 3))
     v = jnp.transpose(v, (1, 2, 0, 3))
+    out = _attend(q, k, v, valid_length, causal)
+    return jnp.transpose(out, (2, 0, 1, 3)).reshape(L, B, heads * D)
+
+
+def _attend(q, k, v, valid_length, causal):
+    """Shared masked-attention core on (B, H, L, D) tensors."""
+    jnp = _jnp()
+    L, D = q.shape[2], q.shape[3]
     scale = 1.0 / float(D) ** 0.5
     steps = jnp.arange(L, dtype=jnp.int32)
     seg = (steps[None, :] < valid_length.astype(jnp.int32)[:, None]) \
@@ -183,11 +191,25 @@ def _masked_selfatt(qkv, valid_length, heads=1, causal=False):
 
         # branch resolved per compile platform at lowering time: TPU gets the
         # Pallas kernel, CPU (tests, host-side eval) the dense fallback
-        out = jax.lax.platform_dependent(q, k, v, seg,
-                                         tpu=_tpu, default=_portable)
-    else:
-        out = _dense_sdpa(q, k, v, seg, causal, scale)
-    return jnp.transpose(out, (2, 0, 1, 3)).reshape(L, B, heads * D)
+        return jax.lax.platform_dependent(q, k, v, seg,
+                                          tpu=_tpu, default=_portable)
+    return _dense_sdpa(q, k, v, seg, causal, scale)
+
+
+@register("contrib.masked_att_qkv")
+def _masked_att_qkv(q, k, v, valid_length, num_kv_groups=1, causal=False):
+    """Masked attention over SEPARATE (B, H, L, D) q/k/v tensors — the
+    modern-LLM entry point (no interleave round-trip; the BERT-era
+    ``masked_selfatt`` keeps the reference transformer.cc layout).
+
+    k/v may carry fewer heads (GQA): num_kv_groups = H_q / H_kv query
+    groups per kv head; the broadcast happens HERE, adjacent to the
+    kernel, so callers never materialize repeated kv projections."""
+    jnp = _jnp()
+    if num_kv_groups > 1:
+        k = jnp.repeat(k, num_kv_groups, axis=1)
+        v = jnp.repeat(v, num_kv_groups, axis=1)
+    return _attend(q, k, v, valid_length, causal)
 
 
 @register("contrib.interleaved_matmul_encdec_qk")
