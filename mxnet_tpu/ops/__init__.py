@@ -18,3 +18,4 @@ from . import optimizer_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import linalg  # noqa: F401
 from . import contrib  # noqa: F401
+from . import vision  # noqa: F401

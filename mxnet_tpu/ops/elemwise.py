@@ -276,3 +276,9 @@ _scalar("_lesser_equal_scalar", lambda jnp, a, b: (a <= b).astype(a.dtype),
 @register("clip")
 def _clip(x, a_min=None, a_max=None):
     return _jnp().clip(x, a_min, a_max)
+
+
+@register("digamma")
+def _digamma(x):
+    import jax
+    return jax.scipy.special.digamma(x)

@@ -408,3 +408,51 @@ def _arange_op(start=0, stop=None, step=1.0, repeat=1, dtype="float32"):
 def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32"):
     return _jnp().linspace(start, stop, int(num), endpoint=endpoint,
                            dtype=dtype)
+
+
+@register("batch_take")
+def _batch_take(a, indices):
+    """reference indexing_op.cc batch_take: out[i] = a[i, indices[i]]."""
+    jnp = _jnp()
+    idx = indices.astype(jnp.int32)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register("reshape_like")
+def _reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                  rhs_end=None):
+    """reference matrix_op.cc reshape_like: reshape lhs dims
+    [lhs_begin, lhs_end) to rhs dims [rhs_begin, rhs_end); full-shape
+    copy when no ranges given."""
+    if lhs_begin is None and lhs_end is None and rhs_begin is None \
+            and rhs_end is None:
+        return lhs.reshape(rhs.shape)
+    lb = 0 if lhs_begin is None else int(lhs_begin)
+    le = len(lhs.shape) if lhs_end is None else int(lhs_end)
+    rb = 0 if rhs_begin is None else int(rhs_begin)
+    re_ = len(rhs.shape) if rhs_end is None else int(rhs_end)
+    new_shape = tuple(lhs.shape[:lb]) + tuple(rhs.shape[rb:re_]) \
+        + tuple(lhs.shape[le:])
+    return lhs.reshape(new_shape)
+
+
+@register("unravel_index", differentiable=False)
+def _unravel_index(data, shape=()):
+    """reference ravel.cc: flat indices → (ndim, N) coordinates."""
+    jnp = _jnp()
+    coords = jnp.unravel_index(data.astype(jnp.int32), tuple(shape))
+    return jnp.stack(list(coords), axis=0)
+
+
+@register("ravel_multi_index", differentiable=False)
+def _ravel_multi_index(data, shape=()):
+    """reference ravel.cc: (ndim, N) coordinates → flat indices."""
+    jnp = _jnp()
+    shape = tuple(shape)
+    strides = []
+    s = 1
+    for d in reversed(shape):
+        strides.append(s)
+        s *= d
+    strides = jnp.asarray(list(reversed(strides)), data.dtype)
+    return (data * strides[:, None]).sum(axis=0)

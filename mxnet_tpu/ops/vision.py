@@ -1,0 +1,316 @@
+"""Vision/spatial operators (reference src/operator/ misc + contrib:
+upsampling.cc, grid_generator.cc, bilinear_sampler.cc,
+spatial_transformer.cc, roi_pooling.cc, contrib/roi_align.cc,
+crop.cc, correlation.cc, svm_output.cc — SURVEY §2.2 'misc top-level').
+
+All NCHW; the bilinear-sampling core is shared by BilinearSampler,
+SpatialTransformer and ROIAlign (gather + lerp — XLA fuses the gathers;
+no hand kernels needed on TPU).
+"""
+
+from __future__ import annotations
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _bilinear_gather(data, xs, ys):
+    """Sample data (N, C, H, W) at float pixel coords xs/ys (N, Ho, Wo)
+    with bilinear interpolation; out-of-range samples read clamped edges
+    weighted to zero like the reference (zero padding outside)."""
+    jnp = _jnp()
+    N, C, H, W = data.shape
+    x0 = jnp.floor(xs)
+    y0 = jnp.floor(ys)
+    wx = (xs - x0)[:, None]                    # (N, 1, Ho, Wo)
+    wy = (ys - y0)[:, None]
+
+    def tap(yi, xi):
+        inb = ((xi >= 0) & (xi <= W - 1) & (yi >= 0)
+               & (yi <= H - 1))[:, None]
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        batch = jnp.arange(N)[:, None, None]
+        vals = data[batch, :, yc, xc]          # (N, Ho, Wo, C)
+        vals = jnp.moveaxis(vals, -1, 1)       # (N, C, Ho, Wo)
+        return vals * inb.astype(data.dtype)
+
+    out = (tap(y0, x0) * (1 - wx) * (1 - wy)
+           + tap(y0, x0 + 1) * wx * (1 - wy)
+           + tap(y0 + 1, x0) * (1 - wx) * wy
+           + tap(y0 + 1, x0 + 1) * wx * wy)
+    return out.astype(data.dtype)
+
+
+@register("UpSampling")
+def _upsampling(*args, scale=2, sample_type="nearest", num_args=1,
+                num_filter=0, multi_input_mode="concat", workspace=0):  # noqa: ARG001
+    """reference upsampling.cc.
+
+    nearest: repeat pixels; several inputs upsample to the FIRST input's
+    target size and concat on channels (multi_input_mode='concat') or sum.
+    bilinear: a strided transposed convolution with the provided weight
+    (reference lowers to Deconvolution with kernel 2s - s%2, pad
+    ceil((s-1)/2), stride s, one group per channel) — the weight is the
+    second positional input and stays learnable.
+    """
+    jnp = _jnp()
+    if sample_type == "bilinear":
+        data, weight = args[0], args[1]
+        C = data.shape[1]
+        k = 2 * scale - scale % 2
+        pad = (scale - 1 + 1) // 2  # ceil((scale-1)/2)
+        from .nn import _deconvolution
+        return _deconvolution(data, weight, None, kernel=(k, k),
+                              stride=(scale, scale), pad=(pad, pad),
+                              num_filter=num_filter or C, num_group=C,
+                              no_bias=True)
+    H, W = args[0].shape[2], args[0].shape[3]
+    outs = []
+    for a in args[:max(num_args, 1)]:
+        s = (H * scale) // a.shape[2]
+        outs.append(jnp.repeat(jnp.repeat(a, s, axis=2), s, axis=3))
+    if len(outs) == 1:
+        return outs[0]
+    if multi_input_mode == "sum":
+        total = outs[0]
+        for o in outs[1:]:
+            total = total + o
+        return total
+    return jnp.concatenate(outs, axis=1)
+
+
+@register("GridGenerator")
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """reference grid_generator.cc: affine θ (N, 6) → sampling grid
+    (N, 2, Ho, Wo) in [-1, 1] (x then y), or 'warp' flow field input."""
+    jnp = _jnp()
+    if transform_type == "affine":
+        N = data.shape[0]
+        Ho, Wo = target_shape
+        theta = data.reshape(N, 2, 3)
+        ys, xs = jnp.meshgrid(
+            jnp.linspace(-1.0, 1.0, Ho), jnp.linspace(-1.0, 1.0, Wo),
+            indexing="ij")
+        ones = jnp.ones_like(xs)
+        src = jnp.stack([xs, ys, ones], axis=0).reshape(3, -1)  # (3, Ho*Wo)
+        out = jnp.einsum("nij,jk->nik", theta.astype(jnp.float32),
+                         src.astype(jnp.float32))               # (N, 2, HW)
+        return out.reshape(N, 2, Ho, Wo).astype(data.dtype)
+    # warp: data (N, 2, H, W) flow added to the identity grid
+    N, _, H, W = data.shape
+    ys, xs = jnp.meshgrid(jnp.arange(H), jnp.arange(W), indexing="ij")
+    gx = (2.0 * (xs + data[:, 0]) / max(W - 1, 1)) - 1.0
+    gy = (2.0 * (ys + data[:, 1]) / max(H - 1, 1)) - 1.0
+    return jnp.stack([gx, gy], axis=1).astype(data.dtype)
+
+
+def _sample_with_grid(data, grid):
+    """grid (N, 2, Ho, Wo) in [-1,1] → bilinear samples (N, C, Ho, Wo)."""
+    H, W = data.shape[2], data.shape[3]
+    xs = (grid[:, 0].astype("float32") + 1.0) * (W - 1) / 2.0
+    ys = (grid[:, 1].astype("float32") + 1.0) * (H - 1) / 2.0
+    return _bilinear_gather(data, xs, ys)
+
+
+@register("BilinearSampler")
+def _bilinear_sampler(data, grid, cudnn_off=False):  # noqa: ARG001
+    """reference bilinear_sampler.cc (STN sampling step)."""
+    return _sample_with_grid(data, grid)
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine",
+                         sampler_type="bilinear", cudnn_off=False):  # noqa: ARG001
+    """reference spatial_transformer.cc: affine grid + bilinear sample."""
+    grid = _grid_generator(loc, transform_type="affine",
+                           target_shape=tuple(target_shape))
+    return _sample_with_grid(data, grid)
+
+
+_ROI_POOL_SAMPLES = 4  # dense sample grid per bin for the static-shape max
+
+
+@register("ROIPooling")
+def _roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """reference roi_pooling.cc: MAX-pool each roi into a fixed grid.
+    rois (R, 5): [batch_idx, x1, y1, x2, y2] in image coords.
+
+    XLA needs static shapes, so instead of iterating the (dynamic) set of
+    integer pixels per bin, each bin takes the max over a dense
+    ``_ROI_POOL_SAMPLES``² grid of samples SNAPPED to integer pixels (the
+    reference max-pools raw pixels, no interpolation) — exact for bins up
+    to ``_ROI_POOL_SAMPLES`` px per side, an approximation beyond."""
+    jnp = _jnp()
+    import jax
+    N, C, H, W = data.shape
+    Ph, Pw = pooled_size
+    s = _ROI_POOL_SAMPLES
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (jnp.round(roi[1:5].astype(jnp.float32)
+                                    * spatial_scale))
+        bh = jnp.maximum(y2 - y1 + 1, 1.0) / Ph
+        bw = jnp.maximum(x2 - x1 + 1, 1.0) / Pw
+        iy = y1 + (jnp.arange(Ph * s) + 0.5) * (bh / s)
+        ix = x1 + (jnp.arange(Pw * s) + 0.5) * (bw / s)
+        yi = jnp.clip(jnp.round(iy - 0.5), 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(jnp.round(ix - 0.5), 0, W - 1).astype(jnp.int32)
+        samp = data[b][:, yi][:, :, xi]          # (C, Ph*s, Pw*s) pixels
+        return samp.reshape(C, Ph, s, Pw, s).max(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois).astype(data.dtype)
+
+
+@register("contrib.roi_align")
+def _roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+               sample_ratio=-1, aligned=False, position_sensitive=False):
+    """reference contrib/roi_align.cc: average of bilinear samples per bin.
+
+    Defaults follow the reference (aligned=False, sample_ratio=-1);
+    adaptive sampling (-1) uses a fixed 2x2 grid here — the adaptive
+    count is roi-size-dependent, which XLA's static shapes can't express.
+    position_sensitive=True (PS-ROI) is not implemented."""
+    from ..base import MXNetError
+    if position_sensitive:
+        raise MXNetError(
+            "contrib.roi_align: position_sensitive=True (PS-ROI pooling) "
+            "is not implemented in the TPU rebuild")
+    jnp = _jnp()
+    import jax
+    Ph, Pw = pooled_size
+    s = int(sample_ratio) if int(sample_ratio) > 0 else 2
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1].astype(jnp.float32) * spatial_scale - offset
+        y1 = roi[2].astype(jnp.float32) * spatial_scale - offset
+        x2 = roi[3].astype(jnp.float32) * spatial_scale - offset
+        y2 = roi[4].astype(jnp.float32) * spatial_scale - offset
+        bh = (y2 - y1) / Ph
+        bw = (x2 - x1) / Pw
+        iy = y1 + (jnp.arange(Ph * s) + 0.5) * (bh / s)  # (Ph*s,)
+        ix = x1 + (jnp.arange(Pw * s) + 0.5) * (bw / s)
+        ys = jnp.broadcast_to(iy[:, None], (Ph * s, Pw * s))
+        xs = jnp.broadcast_to(ix[None, :], (Ph * s, Pw * s))
+        samp = _bilinear_gather(data[b][None], xs[None], ys[None])[0]
+        C = samp.shape[0]
+        samp = samp.reshape(C, Ph, s, Pw, s)
+        return samp.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois).astype(data.dtype)
+
+
+@register("Crop")
+def _crop(data, *like, offset=(0, 0), h_w=(0, 0), num_args=1,
+          center_crop=False):  # noqa: ARG001
+    """reference crop.cc: crop data's spatial dims to h_w (or to the
+    second input's shape) at offset / centered."""
+    if like:
+        th, tw = like[0].shape[2], like[0].shape[3]
+    else:
+        th, tw = h_w
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = offset
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+@register("Correlation")
+def _correlation(data1, data2, kernel_size=1, max_displacement=1,
+                 stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """reference correlation.cc (FlowNet cost volume): mean dot product
+    of patches of data1 with displaced patches of data2.  Out-of-image
+    taps read ZEROS (never wrap); odd kernel_size only (the window is
+    centered, matching the reference's typical configs)."""
+    from ..base import MXNetError
+    if kernel_size % 2 == 0:
+        raise MXNetError("Correlation: kernel_size must be odd")
+    jnp = _jnp()
+    N, C, H, W = data1.shape
+    d = max_displacement
+    k = kernel_size // 2
+    # pad enough that every displaced/windowed tap stays in-bounds and
+    # reads an explicit zero — static slices, no circular wraparound
+    m = pad_size + d + k
+    pad = [(0, 0), (0, 0), (m, m), (m, m)]
+    a = jnp.pad(data1, pad)
+    b = jnp.pad(data2, pad)
+    Hp, Wp = H + 2 * pad_size, W + 2 * pad_size
+    base = d + k  # offset of the pad_size-padded image inside the m-pad
+
+    def window(arr, oy, ox):
+        return arr[:, :, base + oy:base + oy + Hp,
+                   base + ox:base + ox + Wp]
+
+    a0 = window(a, 0, 0)
+    outs = []
+    norm = C * kernel_size * kernel_size
+    for dy in range(-d, d + 1, stride2):
+        for dx in range(-d, d + 1, stride2):
+            acc = None
+            for ky in range(-k, k + 1):
+                for kx in range(-k, k + 1):
+                    a_tap = window(a, ky, kx) if (ky or kx) else a0
+                    b_tap = window(b, dy + ky, dx + kx)
+                    prod = a_tap * b_tap if is_multiply \
+                        else -jnp.abs(a_tap - b_tap)
+                    acc = prod if acc is None else acc + prod
+            outs.append(acc.sum(axis=1) / norm)
+    out = jnp.stack(outs, axis=1)  # (N, D*D, Hp, Wp)
+    # reference output spans the padded image minus the border
+    # (border = max_displacement + kernel_radius) on each side
+    border = d + k
+    out = out[:, :, border:Hp - border, border:Wp - border]
+    return out[:, :, ::stride1, ::stride1].astype(data1.dtype)
+
+
+@register("SVMOutput")
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False):
+    """reference svm_output.cc: identity forward, HINGE backward.
+
+    SVMOutput IS the loss layer: scores pass through unchanged, and the
+    gradient w.r.t. scores is the one-vs-all hinge — with t_j = +1 for
+    the labeled class and -1 otherwise,
+      L1 (use_linear=True):  d/ds_j = -reg * t_j          if margin > s_j t_j
+      L2 (default):          d/ds_j = -2 reg t_j (margin - s_j t_j)  if >
+    implemented as a custom_vjp so the label shapes the gradient exactly
+    like the reference kernel."""
+    import jax
+    jnp = _jnp()
+
+    @jax.custom_vjp
+    def svm(scores, lab):  # noqa: ARG001 — identity forward
+        return scores
+
+    def fwd(scores, lab):
+        return scores, (scores, lab)
+
+    def bwd(res, g):
+        scores, lab = res
+        n_class = scores.shape[1]
+        onehot = jax.nn.one_hot(lab.astype(jnp.int32), n_class,
+                                dtype=scores.dtype)
+        t = 2.0 * onehot - 1.0                       # +1 labeled, -1 rest
+        viol = (margin - scores * t) > 0
+        reg = regularization_coefficient
+        if use_linear:
+            gs = jnp.where(viol, -reg * t, 0.0)
+        else:
+            gs = jnp.where(viol, -2.0 * reg * t * (margin - scores * t),
+                           0.0)
+        # upstream grad g scales the loss like the reference's req scaling
+        return (g * gs.astype(scores.dtype), None)
+
+    svm.defvjp(fwd, bwd)
+    return svm(data, label)
