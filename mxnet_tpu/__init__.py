@@ -79,7 +79,8 @@ def __getattr__(name):
               "io", "image", "kvstore", "profiler", "runtime", "symbol",
               "parallel", "test_utils", "recordio", "callback", "model",
               "util", "numpy", "numpy_extension", "contrib", "amp", "module",
-              "monitor", "checkpoint", "dmlc_params", "operator"}
+              "monitor", "checkpoint", "dmlc_params", "operator",
+              "pipeline"}
     if name in lazies:
         mod = _lazy(name)
         globals()[name] = mod
@@ -104,5 +105,10 @@ def __getattr__(name):
     if name == "kv":
         mod = _lazy("kvstore")
         globals()["kv"] = mod
+        return mod
+    if name == "init":
+        # reference: `from . import initializer as init` (python/mxnet/__init__.py)
+        mod = _lazy("initializer")
+        globals()["init"] = mod
         return mod
     raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

@@ -288,13 +288,13 @@ class CachedOp:
         mutated_idx = []  # filled during trace
         key_uses = [0]    # whether the block consumes RNG (dropout etc.)
 
+        from ..ndarray.ndarray import swap_slot_values
+
         def raw(key, *arrays):
             p_arr = arrays[:n_p]
             i_arr = arrays[n_p:]
-            saved = [(p._data._slot, p._data._slot.value) for p in param_list]
-            try:
-                for p, a in zip(param_list, p_arr):
-                    p._data._slot.value = a
+            with swap_slot_values(zip((p._data for p in param_list),
+                                      p_arr)) as saved:
                 in_nds = [NDArray._from_data(a) for a in i_arr]
                 scope = _rnd.trace_key_scope(key)
                 with autograd._scope(recording=False, training=train_mode), \
@@ -313,9 +313,6 @@ class CachedOp:
                 # single output must be a leaf, not a 1-tuple, so the captured
                 # vjp accepts a bare cotangent
                 return all_out if len(all_out) > 1 else all_out[0]
-            finally:
-                for slot, old in saved:
-                    slot.value = old
 
         jitted = jax.jit(raw)
         # abstract trace now so mutated_idx and the output count are known

@@ -31,6 +31,33 @@ from ..base import MXNetError, dtype_from_any, mx_real_t
 from ..context import Context, current_context
 from .. import engine as _engine
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def swap_slot_values(pairs):
+    """Temporarily point NDArray slots at traced values; restore on exit.
+
+    ``pairs`` — iterable of (NDArray, new_jax_value).  Yields the saved
+    ``[(slot, old_value), ...]`` list so callers can diff old-vs-current to
+    detect in-trace mutation.  This is THE tracing discipline shared by
+    CachedOp (gluon/block.py), TrainStep (parallel.py) and the pipeline
+    stage bridge (pipeline.py): trace a stateful imperative program as a
+    pure function of its parameter values.  Restores raw slot values only —
+    deliberately bypasses version bumps, since the swap must be invisible
+    to the host-side engine ledger.
+    """
+    pairs = list(pairs)
+    saved = [(nd_arr._slot, nd_arr._slot.value) for nd_arr, _ in pairs]
+    try:
+        for nd_arr, val in pairs:
+            nd_arr._slot.value = val
+        yield saved
+    finally:
+        for slot, old in saved:
+            slot.value = old
+
+
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concat", "save", "load", "waitall", "from_numpy", "from_dlpack"]
 

@@ -255,15 +255,23 @@ def _correlation(data1, data2, kernel_size=1, max_displacement=1,
     a0 = window(a, 0, 0)
     outs = []
     norm = C * kernel_size * kernel_size
-    for dy in range(-d, d + 1, stride2):
-        for dx in range(-d, d + 1, stride2):
+    # reference correlation.cc: neighborhood_grid_radius = d / stride2;
+    # displacements are stride2 * {-radius .. +radius} — always centered on
+    # the zero-displacement channel (not range(-d, d+1, stride2), which
+    # loses the center whenever stride2 ∤ d)
+    radius = d // stride2
+    disps = [stride2 * i for i in range(-radius, radius + 1)]
+    for dy in disps:
+        for dx in disps:
             acc = None
             for ky in range(-k, k + 1):
                 for kx in range(-k, k + 1):
                     a_tap = window(a, ky, kx) if (ky or kx) else a0
                     b_tap = window(b, dy + ky, dx + kx)
+                    # is_multiply=False accumulates the POSITIVE SAD cost
+                    # (reference fabsf(data1-data2))
                     prod = a_tap * b_tap if is_multiply \
-                        else -jnp.abs(a_tap - b_tap)
+                        else jnp.abs(a_tap - b_tap)
                     acc = prod if acc is None else acc + prod
             outs.append(acc.sum(axis=1) / norm)
     out = jnp.stack(outs, axis=1)  # (N, D*D, Hp, Wp)

@@ -403,51 +403,43 @@ class TrainStep:
         loss_fn = self.loss_fn
         net = self.net
 
+        from .ndarray.ndarray import swap_slot_values
+
         def raw(key, t, lr_vec, rescale, param_vals, state_vals, d, l):
             import jax.numpy as jnp
-            saved_p = [(p._data._slot, p._data._slot.value) for p in params]
-            saved_g = [(p._data._grad._slot, p._data._grad._slot.value)
-                       for p in trainable]
             saved_opt = (optzr._update_count, optzr._index_update_count,
                          optzr._get_lr, optzr.rescale_grad)
-            saved_s = [(s._slot, s._slot.value) for s in state_nds]
+            # one swap covers params + optimizer state + grad buffers
+            # (grads enter zeroed in-trace: params the loss does not reach
+            # keep a zero gradient — the reference tolerates stale grads)
+            pairs = (list(zip((p._data for p in params), param_vals))
+                     + list(zip(state_nds, state_vals))
+                     + [(p._data._grad,
+                         jnp.zeros(p.shape, p._data._grad.dtype))
+                        for p in trainable])
             try:
-                for p, v in zip(params, param_vals):
-                    p._data._slot.value = v
-                for s, v in zip(state_nds, state_vals):
-                    s._slot.value = v
-                # zero grad buffers in-trace: params the loss does not reach
-                # keep a zero gradient (reference tolerates stale grads)
-                for p in trainable:
-                    p._data._grad._slot.value = jnp.zeros(
-                        p.shape, p._data._grad.dtype)
-                optzr._update_count = lambda idx: None
-                optzr._index_update_count = _TracedCount(t)
-                optzr._get_lr = lambda idx: lr_vec[idx]
-                optzr.rescale_grad = rescale
+                with swap_slot_values(pairs):
+                    optzr._update_count = lambda idx: None
+                    optzr._index_update_count = _TracedCount(t)
+                    optzr._get_lr = lambda idx: lr_vec[idx]
+                    optzr.rescale_grad = rescale
 
-                d_nd, l_nd = NDArray._from_data(d), NDArray._from_data(l)
-                scope = _rnd.trace_key_scope(key)
-                with scope, autograd._scope(recording=True, training=True):
-                    out = net(d_nd)
-                    loss = loss_fn(out, l_nd)
-                    if loss.shape:
-                        loss = loss.mean()
-                autograd.backward([loss])
-                for i, p in enumerate(trainable):
-                    optzr.update_multi_precision(i, p._data,
-                                                 p._data._grad,
-                                                 self._states[i])
-                new_p = tuple(p._data._slot.value for p in params)
-                new_s = tuple(s._slot.value for s in state_nds)
-                return new_p, new_s, loss._data
+                    d_nd, l_nd = NDArray._from_data(d), NDArray._from_data(l)
+                    scope = _rnd.trace_key_scope(key)
+                    with scope, autograd._scope(recording=True, training=True):
+                        out = net(d_nd)
+                        loss = loss_fn(out, l_nd)
+                        if loss.shape:
+                            loss = loss.mean()
+                    autograd.backward([loss])
+                    for i, p in enumerate(trainable):
+                        optzr.update_multi_precision(i, p._data,
+                                                     p._data._grad,
+                                                     self._states[i])
+                    new_p = tuple(p._data._slot.value for p in params)
+                    new_s = tuple(s._slot.value for s in state_nds)
+                    return new_p, new_s, loss._data
             finally:
-                for slot, old in saved_p:
-                    slot.value = old
-                for slot, old in saved_g:
-                    slot.value = old
-                for slot, old in saved_s:
-                    slot.value = old
                 (optzr._update_count, optzr._index_update_count,
                  optzr._get_lr, optzr.rescale_grad) = saved_opt
 

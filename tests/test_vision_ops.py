@@ -95,6 +95,24 @@ def test_correlation_self_displacement_zero():
                                rtol=1e-5)
 
 
+def test_correlation_stride2_and_sad():
+    """stride2 ∤ max_displacement keeps the zero-displacement center channel
+    (reference radius = d // stride2), and is_multiply=False is a POSITIVE
+    SAD cost volume (reference accumulates fabsf)."""
+    r = np.random.RandomState(3)
+    x = nd.array(r.randn(1, 2, 5, 5).astype(np.float32))
+    out = nd.Correlation(x, x, kernel_size=1, max_displacement=3, stride2=2,
+                         pad_size=3)
+    # radius = 3 // 2 = 1 → displacements {-2, 0, 2} → 9 channels
+    assert out.shape[1] == 9
+    np.testing.assert_allclose(out.asnumpy()[0, 4],
+                               (x.asnumpy()[0] ** 2).mean(0), rtol=1e-5)
+    sad = nd.Correlation(x, x, kernel_size=1, max_displacement=1,
+                         is_multiply=False).asnumpy()
+    assert (sad >= 0).all()          # positive cost volume
+    np.testing.assert_allclose(sad[0, 4], 0.0, atol=1e-6)  # self-SAD = 0
+
+
 def test_batch_take_and_reshape_like():
     a = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
     idx = nd.array(np.array([1, 3, 0]))
