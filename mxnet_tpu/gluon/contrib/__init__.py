@@ -1,3 +1,4 @@
 """gluon.contrib (reference python/mxnet/gluon/contrib/)."""
 
 from . import estimator  # noqa: F401
+from .moe import SparseMoE  # noqa: F401 — MoE/expert parallelism (new vs reference)

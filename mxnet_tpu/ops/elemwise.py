@@ -136,7 +136,10 @@ def _softrelu(x):
 
 
 @register("gelu")
-def _gelu(x, approximate=True):
+def _gelu(x, approximate=False):
+    # exact erf form by default: the reference's gelu (leaky_relu.cc
+    # act_type='gelu') is 0.5x(1+erf(x/√2)), and Activation('gelu')
+    # already dispatches approximate=False — keep both paths identical
     import jax
     return jax.nn.gelu(x, approximate=approximate)
 

@@ -388,7 +388,13 @@ class TrainStep:
 
     def _param_sharding(self, p):
         if p.sharding:
-            return self.mesh.sharded(*p.sharding)
+            # hints name logical axes ('tp', 'ep', …); axes the current mesh
+            # doesn't carry degrade to unsharded dims so the same model runs
+            # on smaller meshes unchanged
+            spec = tuple(a if a in self.mesh.axis_names else None
+                         for a in p.sharding)
+            if any(a is not None for a in spec):
+                return self.mesh.sharded(*spec)
         return self.mesh.replicated()
 
     # -- trace ----------------------------------------------------------------

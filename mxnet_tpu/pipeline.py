@@ -122,7 +122,7 @@ def gpipe(stage_fn, n_stages, n_microbatches, mesh, axis="pp",
         in_specs = (jax.tree_util.tree_map(lambda _: stage_spec,
                                            params_stacked), act_spec)
         f = shard_map(schedule, mesh=mesh.mesh, in_specs=in_specs,
-                      out_specs=act_spec, check_vma=False)
+                      out_specs=act_spec)
         return f(params_stacked, x)
 
     return jax.jit(wrapped)
