@@ -6,25 +6,26 @@ namespaces generated from the registry.
 
 TPU rebuild scope (SURVEY §7.1): ``amp`` is first-class (re-exported from
 ``mxnet_tpu.amp``); the contrib op namespaces re-export the registry's
-``contrib.*`` ops; ``onnx``/``tensorrt`` are explicitly dropped —
-StableHLO export (``HybridBlock.export``) is the interchange format on
-TPU — and ``quantization`` is deferred post-v1 (N11 ledger row).
+``contrib.*`` ops; ``quantization`` is INT8 post-training quantization over
+the MXU int8 path; ``onnx``/``tensorrt`` are explicitly dropped —
+StableHLO export (``HybridBlock.export``) is the interchange format on TPU.
 """
 
 from .. import amp  # noqa: F401 — reference spells it mx.contrib.amp
 from ..ndarray import contrib as ndarray  # noqa: F401 — contrib op namespace
 from ..symbol import contrib as symbol  # noqa: F401
 
-__all__ = ["amp", "ndarray", "symbol"]
+__all__ = ["amp", "ndarray", "symbol", "quantization"]
 
 
 def __getattr__(name):
+    if name == "quantization":
+        import importlib
+        mod = importlib.import_module(".quantization", __name__)
+        globals()["quantization"] = mod
+        return mod
     if name in ("onnx", "tensorrt"):
         raise AttributeError(
             f"mx.contrib.{name} is not part of the TPU rebuild: model "
             "interchange is StableHLO via HybridBlock.export() (SURVEY §7.1)")
-    if name == "quantization":
-        raise AttributeError(
-            "mx.contrib.quantization (INT8) is deferred post-v1 in the TPU "
-            "rebuild (SURVEY §7.1 N11 row)")
     raise AttributeError(f"module 'mxnet_tpu.contrib' has no attribute {name!r}")

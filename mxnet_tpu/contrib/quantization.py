@@ -1,0 +1,310 @@
+"""INT8 post-training quantization (reference python/mxnet/contrib/quantization.py).
+
+Rebuild of P19 + the graph-pass role of N11 (quantize_graph_pass.cc /
+calibrate.cc), TPU-style: instead of an nnvm graph rewrite, ``quantize_net``
+walks a Gluon block tree and swaps ``Dense``/``Conv2D`` children for
+quantized wrappers that run the int8 MXU ops registered in
+``ops/quantization.py``.  Weights are quantized once at conversion;
+activations are quantized per batch against ranges collected by calibration:
+
+ - ``calib_mode='naive'`` — per-layer min/max over the calib set
+   (reference: ``collect_layer_output_min_max``);
+ - ``calib_mode='entropy'`` — KL-optimal symmetric threshold per layer
+   (reference calibrate.cc :: GetOptimalThreshold, 8-bit / 2048-bin
+   histogram search);
+ - ``calib_mode='none'`` — online per-batch ranges (no calibration pass).
+
+The converted net is inference-only (the reference's quantized graphs are
+too): backward through the rounding is not defined.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D",
+           "optimal_threshold_kl"]
+
+
+def _histogram_collect(hist_state, arr, bins=2048):
+    """Accumulate |x| histogram for entropy calibration (calibrate.cc keeps
+    per-layer histograms across calib batches)."""
+    a = _np.abs(arr.ravel())
+    amax = float(a.max()) if a.size else 0.0
+    if hist_state is None:
+        width = max(amax, 1e-8)
+        hist, _ = _np.histogram(a, bins=bins, range=(0, width))
+        return {"hist": hist.astype(_np.float64), "width": width}
+    if amax > hist_state["width"]:
+        # re-bin the old histogram into the wider range
+        old_edges = _np.linspace(0, hist_state["width"],
+                                 len(hist_state["hist"]) + 1)
+        centers = (old_edges[:-1] + old_edges[1:]) / 2
+        new_hist, _ = _np.histogram(centers, bins=bins, range=(0, amax),
+                                    weights=hist_state["hist"])
+        hist_state = {"hist": new_hist, "width": amax}
+    hist, _ = _np.histogram(a, bins=len(hist_state["hist"]),
+                            range=(0, hist_state["width"]))
+    hist_state["hist"] += hist
+    return hist_state
+
+
+def optimal_threshold_kl(hist, hist_width, num_quantized_bins=255):
+    """KL-divergence-optimal symmetric threshold (the calibrate.cc ::
+    GetOptimalThreshold role).
+
+    For each candidate threshold T the int8 mapping quantizes [0, T] into
+    ``num_quantized_bins`` levels and SATURATES everything above T into the
+    top level.  Q is that mapping's induced distribution over the FULL
+    histogram support (clipped mass lands on the top level's support; bins
+    beyond T that saturation cannot reach get ~zero), and we minimize
+    KL(P_full || Q).  Comparing against the full distribution — not the
+    clipped window — is what penalizes aggressive clipping; a
+    window-normalized comparison degenerates to KL=0 at tiny T."""
+    hist = _np.asarray(hist, _np.float64)
+    nbins = len(hist)
+    total = hist.sum()
+    if total == 0:
+        return hist_width
+    p_full = hist / total
+    eps = 1e-10
+    best_kl, best_t = _np.inf, hist_width
+    step = max(1, (nbins - num_quantized_bins) // 64)
+    for i in range(num_quantized_bins, nbins + 1, step):
+        t = hist_width * i / nbins
+        edges = _np.linspace(0, i, num_quantized_bins + 1).astype(int)
+        q = _np.full(nbins, eps)
+        clipped = hist[i:].sum()
+        for j in range(num_quantized_bins):
+            lo, hi = edges[j], max(edges[j + 1], edges[j] + 1)
+            seg = hist[lo:hi]
+            seg_sum = seg.sum()
+            if j == num_quantized_bins - 1:
+                seg_sum += clipped       # saturated values hit the top level
+            nz = (seg > 0).sum()
+            if nz and seg_sum > 0:
+                q[lo:hi] = _np.where(seg > 0, seg_sum / nz / total, eps)
+        mask = p_full > 0
+        kl = float(_np.sum(p_full[mask]
+                           * _np.log(p_full[mask] / q[mask])))
+        if kl < best_kl:
+            best_kl, best_t = kl, t
+    return best_t
+
+
+class _QuantizedBase:
+    """Shared conversion plumbing: freeze the float layer's weight as int8
+    + range at conversion time, quantize activations per batch.
+
+    Duck-types the Block traversal surface (collect_params/apply/hybridize/
+    cast) so a converted child sits transparently inside any Block tree;
+    it owns no float Parameters (weights are frozen int8)."""
+
+    @property
+    def _children(self):
+        # per-instance child registry (a shared class-level dict would alias
+        # across every quantized layer in the process)
+        if "_children_store" not in self.__dict__:
+            self.__dict__["_children_store"] = {}
+        return self.__dict__["_children_store"]
+
+    def _freeze_weight(self, weight_nd):
+        from .. import ndarray as nd
+        w = weight_nd
+        qw, wmin, wmax = nd.contrib.quantize_v2(w)
+        self._qw, self._wmin, self._wmax = qw, wmin, wmax
+
+    def collect_params(self, select=None):  # noqa: ARG002
+        return {}
+
+    def apply(self, fn):
+        fn(self)
+        return self
+
+    def hybridize(self, active=True, **kwargs):
+        # quantized wrappers dispatch registry ops (each jit-cached);
+        # there is nothing further to fuse and backward is undefined
+        pass
+
+    def cast(self, dtype):
+        raise MXNetError("quantized layers are int8-frozen; cast() is not "
+                         "supported (re-quantize from the float net instead)")
+
+
+class QuantizedDense(_QuantizedBase):
+    """Inference-only int8 replacement for gluon.nn.Dense."""
+
+    def __init__(self, dense, calib_range=None):
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self.act = dense.act
+        self._bias = dense.bias.data() if dense.bias is not None else None
+        self._calib = calib_range     # (min, max) or None for online
+        self._freeze_weight(dense.weight.data())
+        self.name = getattr(dense, "name", "dense")
+
+    def __call__(self, x):
+        from .. import ndarray as nd
+        if self._calib is not None:
+            qx, xmin, xmax = nd.contrib.quantize_v2(
+                x, min_calib_range=float(self._calib[0]),
+                max_calib_range=float(self._calib[1]))
+        else:
+            qx, xmin, xmax = nd.contrib.quantize_v2(x)
+        out32, omin, omax = nd.contrib.quantized_fully_connected(
+            qx, self._qw, xmin, xmax, self._wmin, self._wmax,
+            num_hidden=self._units, flatten=self._flatten)
+        y = nd.contrib.dequantize(out32, omin, omax)
+        if self._bias is not None:
+            y = y + self._bias
+        if self.act is not None:
+            y = self.act(y)
+        return y
+
+
+class QuantizedConv2D(_QuantizedBase):
+    """Inference-only int8 replacement for gluon.nn.Conv2D (NCHW)."""
+
+    def __init__(self, conv, calib_range=None):
+        if conv._kwargs.get("num_group", 1) != 1:
+            raise MXNetError("QuantizedConv2D: grouped conv stays float "
+                             "(exclude it via exclude_layers_match)")
+        self._stride = conv._kwargs.get("stride", (1, 1))
+        self._pad = conv._kwargs.get("pad", (0, 0))
+        self._dilate = conv._kwargs.get("dilate", (1, 1))
+        self.act = getattr(conv, "act", None)
+        self._bias = conv.bias.data() if conv.bias is not None else None
+        self._calib = calib_range
+        self._freeze_weight(conv.weight.data())
+        self.name = getattr(conv, "name", "conv")
+
+    def __call__(self, x):
+        from .. import ndarray as nd
+        if self._calib is not None:
+            qx, xmin, xmax = nd.contrib.quantize_v2(
+                x, min_calib_range=float(self._calib[0]),
+                max_calib_range=float(self._calib[1]))
+        else:
+            qx, xmin, xmax = nd.contrib.quantize_v2(x)
+        out32, omin, omax = nd.contrib.quantized_conv(
+            qx, self._qw, xmin, xmax, self._wmin, self._wmax,
+            stride=self._stride, pad=self._pad, dilate=self._dilate)
+        y = nd.contrib.dequantize(out32, omin, omax)
+        if self._bias is not None:
+            y = y + self._bias.reshape((1, -1, 1, 1))
+        if self.act is not None:
+            y = self.act(y)
+        return y
+
+
+def _deactivate_cached_ops(block):
+    """Drop hybridize state across a block tree: quantized inference runs
+    the imperative path (each int8 op is jit-cached individually), and any
+    pre-conversion CachedOp trace would hold the float params."""
+    if hasattr(block, "_active"):
+        block._active = False
+    if hasattr(block, "_clear_cached_op"):
+        block._clear_cached_op()
+    for child in getattr(block, "_children", {}).values():
+        _deactivate_cached_ops(child)
+
+
+def _quantizable_children(block, prefix=""):
+    from ..gluon import nn
+    out = []
+    for name, child in block._children.items():
+        path = f"{prefix}{name}"
+        if isinstance(child, nn.Dense) or isinstance(child, nn.Conv2D):
+            out.append((block, name, path, child))
+        else:
+            out.extend(_quantizable_children(child, path + "."))
+    return out
+
+
+def quantize_net(net, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers_match=None,
+                 logger=None):
+    """Convert a float Gluon net to int8 inference IN PLACE and return it.
+
+    ``calib_data`` — iterable of input batches (NDArray) for calibration;
+    required for calib_mode 'naive'/'entropy'.  ``exclude_layers_match`` —
+    list of fnmatch patterns of child paths to keep in float (reference
+    kwarg of the same name).
+    """
+    from ..gluon import nn
+    from .. import ndarray as nd
+    if quantized_dtype != "int8":
+        raise MXNetError("TPU quantization supports int8 only (MXU int8 path)")
+    if calib_mode not in ("none", "naive", "entropy"):
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
+    targets = _quantizable_children(net)
+    if exclude_layers_match:
+        targets = [t for t in targets
+                   if not any(fnmatch.fnmatch(t[2], pat)
+                              for pat in exclude_layers_match)]
+    if not targets:
+        return net
+
+    calib_ranges = {}
+    if calib_mode in ("naive", "entropy"):
+        if calib_data is None:
+            raise MXNetError(f"calib_mode={calib_mode!r} needs calib_data")
+        stats = {path: None for _, _, path, _ in targets}
+
+        hooks = []
+
+        def make_hook(path):
+            def pre_hook(blk, inputs):  # noqa: ARG001
+                a = inputs[0].asnumpy()
+                if calib_mode == "naive":
+                    cur = stats[path]
+                    mn, mx = float(a.min()), float(a.max())
+                    stats[path] = (mn, mx) if cur is None else \
+                        (min(cur[0], mn), max(cur[1], mx))
+                else:
+                    stats[path] = _histogram_collect(stats[path], a)
+            return pre_hook
+
+        # calibration must run the imperative (hooked) path: a hybridized
+        # net would dispatch its CachedOp and never fire the pre-hooks —
+        # and its cached trace would go stale once children are swapped
+        _deactivate_cached_ops(net)
+        for _, _, path, child in targets:
+            child.register_forward_pre_hook(make_hook(path))
+            hooks.append(child)
+        try:
+            for batch in calib_data:
+                net(batch if isinstance(batch, nd.NDArray)
+                    else nd.array(batch))
+        finally:
+            for child in hooks:
+                child._forward_pre_hooks.pop()
+        for _, _, path, _ in targets:
+            st = stats[path]
+            if st is None:
+                continue
+            if calib_mode == "naive":
+                calib_ranges[path] = st
+            else:
+                t = optimal_threshold_kl(st["hist"], st["width"])
+                calib_ranges[path] = (-t, t)
+
+    _deactivate_cached_ops(net)   # also for calib_mode='none'
+    for parent, name, path, child in targets:
+        rng = calib_ranges.get(path)
+        if isinstance(child, nn.Dense):
+            q = QuantizedDense(child, calib_range=rng)
+        else:
+            q = QuantizedConv2D(child, calib_range=rng)
+        parent._children[name] = q
+        # attribute access (net.fc1 …) must resolve to the wrapper too
+        for attr, val in list(vars(parent).items()):
+            if val is child:
+                object.__setattr__(parent, attr, q)
+        if logger:
+            logger.info("quantized %s (calib=%s)", path, rng)
+    return net

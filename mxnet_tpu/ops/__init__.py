@@ -19,3 +19,4 @@ from . import random_ops  # noqa: F401
 from . import linalg  # noqa: F401
 from . import contrib  # noqa: F401
 from . import vision  # noqa: F401
+from . import quantization  # noqa: F401

@@ -1,0 +1,148 @@
+"""INT8 quantization (ops/quantization.py + contrib/quantization.py).
+
+Reference: src/operator/quantization/ + python/mxnet/contrib/quantization.py
+(SURVEY N11/P19) — op-level round-trip/matmul accuracy, KL calibration, and
+quantize_net end-to-end accuracy on an MLP and a small CNN.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import quantization as qz
+from mxnet_tpu.gluon import nn
+
+
+def test_quantize_dequantize_roundtrip():
+    x = np.random.RandomState(0).randn(64, 32).astype(np.float32) * 3
+    q, mn, mxr = nd.contrib.quantize_v2(nd.array(x))
+    assert q.asnumpy().dtype == np.int8
+    real = max(abs(x.min()), abs(x.max()))
+    np.testing.assert_allclose(float(mxr.asnumpy()), real, rtol=1e-6)
+    back = nd.contrib.dequantize(q, mn, mxr).asnumpy()
+    # max error is half a quantization step
+    assert np.abs(back - x).max() <= real / 127 * 0.5 + 1e-6
+
+
+def test_quantize_with_calib_range_clips():
+    x = nd.array(np.array([[-10.0, -1.0, 0.5, 9.0]], np.float32))
+    q, mn, mxr = nd.contrib.quantize_v2(x, min_calib_range=-2.0,
+                                        max_calib_range=2.0)
+    qa = q.asnumpy()
+    assert qa[0, 0] == -127 and qa[0, 3] == 127      # clipped
+    np.testing.assert_allclose(float(mxr.asnumpy()), 2.0)
+
+
+def test_quantized_fully_connected_accuracy():
+    r = np.random.RandomState(1)
+    x = r.randn(16, 32).astype(np.float32)
+    w = r.randn(8, 32).astype(np.float32) * 0.5
+    qx, xmin, xmax = nd.contrib.quantize_v2(nd.array(x))
+    qw, wmin, wmax = nd.contrib.quantize_v2(nd.array(w))
+    out32, omin, omax = nd.contrib.quantized_fully_connected(
+        qx, qw, xmin, xmax, wmin, wmax, num_hidden=8)
+    assert out32.asnumpy().dtype == np.int32
+    y = nd.contrib.dequantize(out32, omin, omax).asnumpy()
+    ref = x @ w.T
+    # int8 matmul keeps ~1% relative error at this K
+    assert np.abs(y - ref).max() / np.abs(ref).max() < 0.02
+
+
+def test_requantize_int32_to_int8():
+    r = np.random.RandomState(2)
+    x = r.randn(8, 16).astype(np.float32)
+    w = r.randn(4, 16).astype(np.float32)
+    qx, xmin, xmax = nd.contrib.quantize_v2(nd.array(x))
+    qw, wmin, wmax = nd.contrib.quantize_v2(nd.array(w))
+    out32, omin, omax = nd.contrib.quantized_fully_connected(
+        qx, qw, xmin, xmax, wmin, wmax)
+    q8, nmin, nmax = nd.contrib.requantize(out32, omin, omax)
+    assert q8.asnumpy().dtype == np.int8
+    y = nd.contrib.dequantize(q8, nmin, nmax).asnumpy()
+    ref = x @ w.T
+    assert np.abs(y - ref).max() / np.abs(ref).max() < 0.03
+
+
+def test_kl_threshold_clips_outliers():
+    """A gaussian bulk + one huge outlier: the KL-optimal threshold should
+    sit near the bulk, well below the outlier."""
+    r = np.random.RandomState(3)
+    vals = np.concatenate([r.randn(100000).astype(np.float32),
+                           np.array([50.0], np.float32)])
+    st = qz._histogram_collect(None, vals)
+    t = qz.optimal_threshold_kl(st["hist"], st["width"])
+    assert t < 25.0                      # not fooled by the outlier
+    assert t > 2.0                       # covers the bulk
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"))
+    net.add(nn.Dense(32, activation="relu"))
+    net.add(nn.Dense(10))
+    return net
+
+
+@pytest.mark.parametrize("calib_mode", ["none", "naive", "entropy"])
+def test_quantize_net_mlp_accuracy(calib_mode):
+    mx.random.seed(4)
+    net = _mlp()
+    net.initialize(mx.init.Xavier())
+    r = np.random.RandomState(5)
+    x = nd.array(r.randn(32, 16).astype(np.float32))
+    ref = net(x).asnumpy()
+    calib = [nd.array(r.randn(32, 16).astype(np.float32)) for _ in range(4)]
+    calib.append(x)
+    qnet = qz.quantize_net(net, calib_data=calib, calib_mode=calib_mode)
+    out = qnet(x).asnumpy()
+    scale = np.abs(ref).max()
+    # entropy calibration deliberately clips distribution tails (KL picks
+    # resolution over range), so its worst-case elementwise error compounds
+    # across layers — judge it on mean error; naive keeps tight max error
+    if calib_mode == "entropy":
+        assert np.abs(out - ref).mean() / scale < 0.03
+        assert np.abs(out - ref).max() / scale < 0.30
+    else:
+        assert np.abs(out - ref).max() / scale < 0.05, calib_mode
+
+
+def test_quantize_net_excludes_layers():
+    net = _mlp()
+    net.initialize()
+    x = nd.array(np.random.RandomState(6).randn(4, 8).astype(np.float32))
+    net(x)
+    qz.quantize_net(net, calib_data=[x], calib_mode="naive",
+                    exclude_layers_match=["2"])   # keep the head in float
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert kinds.count("QuantizedDense") == 2
+    assert kinds.count("Dense") == 1
+
+
+def test_quantize_net_on_hybridized_net():
+    """A hybridized float net must calibrate through the imperative path
+    (hooks) and drop its stale CachedOp trace after conversion."""
+    mx.random.seed(9)
+    net = _mlp()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.array(np.random.RandomState(9).randn(8, 16).astype(np.float32))
+    ref = net(x).asnumpy()          # builds the cached op
+    qz.quantize_net(net, calib_data=[x], calib_mode="naive")
+    out = net(x).asnumpy()          # must not hit the stale trace
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 0.05
+
+
+def test_quantize_net_cnn():
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"))
+    net.add(nn.Conv2D(16, kernel_size=3, strides=2, activation="relu"))
+    net.add(nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(8).randn(2, 3, 16, 16)
+                 .astype(np.float32))
+    ref = net(x).asnumpy()
+    qz.quantize_net(net, calib_data=[x], calib_mode="naive")
+    out = net(x).asnumpy()
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 0.06
