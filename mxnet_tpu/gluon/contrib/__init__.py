@@ -1,4 +1,5 @@
 """gluon.contrib (reference python/mxnet/gluon/contrib/)."""
 
 from . import estimator  # noqa: F401
+from . import nn  # noqa: F401 — SyncBatchNorm/Identity/Concurrent
 from .moe import SparseMoE  # noqa: F401 — MoE/expert parallelism (new vs reference)

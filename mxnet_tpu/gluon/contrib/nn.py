@@ -1,0 +1,73 @@
+"""gluon.contrib.nn — contributed layers.
+
+Reference: python/mxnet/gluon/contrib/nn/basic_layers.py (SyncBatchNorm,
+HybridConcurrent, Identity, …).
+"""
+
+from __future__ import annotations
+
+from ..nn.basic_layers import BatchNorm, HybridSequential
+from ..block import HybridBlock
+
+__all__ = ["SyncBatchNorm", "Identity", "Concurrent", "HybridConcurrent"]
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (reference contrib/nn ::
+    SyncBatchNorm over src/operator/contrib/sync_batch_norm.cc).
+
+    TPU-native statement of the contract: the reference synchronizes batch
+    statistics across the ``num_devices`` data-parallel workers with a
+    key-based barrier.  Under this framework's performance path
+    (``parallel.TrainStep`` — one jitted SPMD program over the mesh) the
+    batch axis is GLOBAL: ``mean``/``var`` reduce over the full sharded
+    batch and GSPMD inserts the cross-device psum, so plain BatchNorm
+    already IS sync-BN — no extra op, no barrier, no second code path.
+    This subclass exists for API parity and for documentation of that
+    absorption; ``num_devices`` is accepted and recorded.
+
+    The legacy per-ctx replica path (gluon.utils.split_and_load + per-ctx
+    forwards) computes per-replica statistics like upstream's plain
+    BatchNorm would; use TrainStep when synchronized statistics matter.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=
+                         running_variance_initializer,
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+
+
+class Identity(HybridBlock):
+    """Pass-through block (reference contrib/nn :: Identity)."""
+
+    def hybrid_forward(self, F, x):  # noqa: ARG002
+        return x
+
+
+class Concurrent(HybridSequential):
+    """Run children on the same input and concat outputs along ``axis``
+    (reference contrib/nn :: Concurrent)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+
+    def forward(self, x):
+        from ... import ndarray as nd
+        outs = [child(x) for child in self._children.values()]
+        return nd.concat(*outs, dim=self._axis)
+
+
+HybridConcurrent = Concurrent

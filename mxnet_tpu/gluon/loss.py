@@ -150,33 +150,23 @@ class CTCLoss(Loss):
 
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
-        import jax.numpy as jnp
-        import optax
-        from ..ndarray.ndarray import NDArray
-        if self._layout == "TNC":
-            pred = pred.swapaxes(0, 1)  # → (N, T, C)
-        if self._label_layout == "TN" :
+        # routed through the registered `ctc_loss` op (nn/ctc_loss.cc
+        # analog) so the imperative tape records a proper vjp
+        if self._layout == "NTC":
+            pred = pred.swapaxes(0, 1)            # op contract: (T, N, C)
+        if self._label_layout == "TN":
             label = label.swapaxes(0, 1)
-        logits = pred._data
-        labels = label._data.astype(jnp.int32)
-        N, T, C = logits.shape
-        if pred_lengths is None:
-            logit_pad = jnp.zeros((N, T), jnp.float32)
-        else:
-            steps = jnp.arange(T)
-            logit_pad = (steps[None, :] >=
-                         pred_lengths._data[:, None]).astype(jnp.float32)
-        L = labels.shape[1]
-        if label_lengths is None:
-            lab_pad = (labels == 0).astype(jnp.float32)
-        else:
-            steps = jnp.arange(L)
-            lab_pad = (steps[None, :] >=
-                       label_lengths._data[:, None]).astype(jnp.float32)
-        loss = optax.ctc_loss(logits, logit_pad, labels, lab_pad,
-                              blank_id=0)
-        out = NDArray._from_data(loss, ctx=pred.ctx)
-        return _apply_weighting(F, out, self._weight, sample_weight)
+        if label_lengths is not None and pred_lengths is None:
+            # op wrappers drop None positionals, which would shift
+            # label_lengths into the data_lengths slot — materialize the
+            # trivial full-length data_lengths instead
+            pred_lengths = F.full((pred.shape[1],), pred.shape[0],
+                                  dtype="int32")
+        loss = F.ctc_loss(pred, label, pred_lengths, label_lengths,
+                          use_data_lengths=pred_lengths is not None,
+                          use_label_lengths=label_lengths is not None,
+                          blank_label="first")
+        return _apply_weighting(F, loss, self._weight, sample_weight)
 
 
 class HuberLoss(Loss):

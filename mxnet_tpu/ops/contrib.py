@@ -382,3 +382,80 @@ def _hawkes_ll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
     compens = jnp.sum(lda * max_time[:, None], axis=-1)
     ll = ll - compens
     return ll, st
+
+
+# ---------------------------------------------------------------------------
+# contrib tail: fft / count_sketch / ctc_loss (reference src/operator/contrib/
+# fft.cc, count_sketch.cc and nn/ctc_loss.cc)
+# ---------------------------------------------------------------------------
+
+@register("contrib.fft")
+def _fft(data, compute_size=128):  # noqa: ARG001 — cuFFT batching knob, n/a
+    """reference contrib/fft.cc: FFT along the last dim; output interleaves
+    real/imag → last dim doubles (the reference's cuFFT layout contract)."""
+    jnp = _jnp()
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+              .astype(jnp.float32)
+
+
+@register("contrib.ifft")
+def _ifft(data, compute_size=128):  # noqa: ARG001
+    """reference contrib/fft.cc: inverse of contrib.fft — input interleaved
+    real/imag (last dim 2n), output real part (last dim n)."""
+    jnp = _jnp()
+    n = data.shape[-1] // 2
+    x = data.reshape(data.shape[:-1] + (n, 2))
+    c = x[..., 0] + 1j * x[..., 1]
+    return jnp.fft.ifft(c, axis=-1).real.astype(jnp.float32) * n
+
+
+@register("contrib.count_sketch")
+def _count_sketch(data, h, s, out_dim=16):
+    """reference contrib/count_sketch.cc (compact bilinear pooling): project
+    (N, d) onto out_dim buckets via hash h (d,) with signs s (d,)."""
+    jnp = _jnp()
+    idx = h.astype(jnp.int32).reshape(-1)
+    sign = s.astype(data.dtype).reshape(-1)
+    contrib_vals = data * sign[None, :]
+    oh = (idx[:, None] == jnp.arange(out_dim)[None, :]).astype(data.dtype)
+    return contrib_vals @ oh
+
+
+@register("ctc_loss")
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False,
+              blank_label="first"):
+    """reference nn/ctc_loss.cc (`mx.nd.ctc_loss`): data (T, N, C) time-major
+    logits, label (N, L) int classes.  blank_label 'first' → blank id 0 and
+    labels are 1-based w.r.t. the alphabet; 'last' → blank id C-1.
+    Differentiable (optax forward-backward), so imperative autograd works."""
+    import optax
+    jnp = _jnp()
+    logits = jnp.transpose(data, (1, 0, 2))          # (N, T, C)
+    labels = label.astype(jnp.int32)
+    N, T, C = logits.shape
+    if use_data_lengths and data_lengths is not None:
+        steps = jnp.arange(T)
+        logit_pad = (steps[None, :]
+                     >= data_lengths.astype(jnp.int32)[:, None]) \
+            .astype(jnp.float32)
+    else:
+        logit_pad = jnp.zeros((N, T), jnp.float32)
+    L = labels.shape[1]
+    if use_label_lengths and label_lengths is not None:
+        steps = jnp.arange(L)
+        lab_pad = (steps[None, :]
+                   >= label_lengths.astype(jnp.int32)[:, None]) \
+            .astype(jnp.float32)
+    else:
+        # reference padding convention: 0 ('first') / -1 pads
+        pad_val = 0 if blank_label == "first" else -1
+        lab_pad = (labels == pad_val).astype(jnp.float32)
+    if blank_label == "last":
+        blank_id = C - 1
+    else:
+        blank_id = 0
+    return optax.ctc_loss(logits, logit_pad, labels, lab_pad,
+                          blank_id=blank_id)

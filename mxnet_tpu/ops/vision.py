@@ -322,3 +322,277 @@ def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
 
     svm.defvjp(fwd, bwd)
     return svm(data, label)
+
+
+# ---------------------------------------------------------------------------
+# SSD multibox family (reference src/operator/contrib/multibox_{prior,target,
+# detection}.cc) + position-sensitive ROI pooling + deformable convolution
+# ---------------------------------------------------------------------------
+
+@register("contrib.MultiBoxPrior", differentiable=False)
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation: for an (N, C, H, W) feature map emit
+    (1, H*W*(S+R-1), 4) corner-format anchors — first ratio paired with all
+    sizes, then remaining ratios with sizes[0] (reference enumeration)."""
+    jnp = _jnp()
+    H, W = data.shape[2], data.shape[3]
+    sizes = [float(s) for s in sizes]
+    ratios = [float(r) for r in ratios]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    # anchor (w, h) list: all sizes at ratios[0], then sizes[0] at ratios[1:]
+    whs = [(s * (ratios[0] ** 0.5), s / (ratios[0] ** 0.5)) for s in sizes]
+    whs += [(sizes[0] * (r ** 0.5), sizes[0] / (r ** 0.5))
+            for r in ratios[1:]]
+    wh = jnp.asarray(whs, jnp.float32)                       # (A, 2)
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1) \
+        .reshape(-1, 2)                                      # (H*W, 2)
+    cxy = cyx[:, ::-1]                                       # (cx, cy)
+    boxes = jnp.concatenate([
+        cxy[:, None, :] - wh[None, :, :] / 2,
+        cxy[:, None, :] + wh[None, :, :] / 2], axis=-1)      # (H*W, A, 4)
+    out = boxes.reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+@register("contrib.MultiBoxTarget", num_outputs=3, differentiable=False)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground-truth boxes: per reference, each gt grabs its
+    best anchor, then anchors with IoU > threshold join; regression targets
+    are variance-scaled center-size offsets.  Returns (loc_target (N, A*4),
+    loc_mask (N, A*4), cls_target (N, A)); cls_target is 1+gt class id, 0
+    for background.  label: (N, G, 5) rows [cls, xmin, ymin, xmax, ymax],
+    cls -1 pads."""
+    jnp = _jnp()
+    import jax
+    A = anchor.shape[1] if anchor.ndim == 3 else anchor.shape[0]
+    anc = anchor.reshape(A, 4)
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+
+    from .contrib import _box_iou                            # shared geometry
+
+    def one_sample(lab):
+        cls = lab[:, 0]
+        boxes = lab[:, 1:5]
+        valid = cls >= 0                                     # (G,)
+        ious = jnp.where(valid[None, :], _box_iou(anc, boxes), -1.0)  # (A, G)
+        best_gt = jnp.argmax(ious, axis=1)                   # per anchor
+        best_iou = jnp.max(ious, axis=1)
+        assigned = best_iou > overlap_threshold
+        # each gt's best anchor is forced-assigned (reference bipartite
+        # step).  Pad rows (cls < 0) must not scatter at all — their argmax
+        # lands on anchor 0 and a duplicate-index write could overwrite a
+        # real gt's claim — so their scatter target is redirected out of
+        # bounds and dropped.
+        best_anchor = jnp.argmax(ious, axis=0)               # (G,)
+        scatter_tgt = jnp.where(valid, best_anchor, A)
+        forced = jnp.zeros((A,), bool) \
+            .at[scatter_tgt].set(True, mode="drop")
+        gt_for_forced = jnp.zeros((A,), jnp.int32) \
+            .at[scatter_tgt].set(jnp.arange(lab.shape[0], dtype=jnp.int32),
+                                 mode="drop")
+        gt_idx = jnp.where(forced, gt_for_forced, best_gt)
+        assigned = assigned | forced
+        g = boxes[gt_idx]                                    # (A, 4)
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-12)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-12)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        loc = jnp.stack([
+            (gcx - acx) / aw / variances[0],
+            (gcy - acy) / ah / variances[1],
+            jnp.log(gw / aw) / variances[2],
+            jnp.log(gh / ah) / variances[3]], axis=-1)       # (A, 4)
+        m = assigned.astype(anc.dtype)[:, None]
+        cls_t = jnp.where(assigned, cls[gt_idx] + 1, 0.0)
+        return (loc * m).reshape(-1), jnp.repeat(m, 4, 1).reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one_sample)(label)
+    del cls_pred  # reference uses it only for negative mining (off here)
+    return loc_t, loc_m, cls_t
+
+
+@register("contrib.MultiBoxDetection", differentiable=False, jit=False)
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, nms_threshold=0.5,
+                        force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
+                        nms_topk=-1):
+    """Decode SSD predictions → (N, A, 6) rows [cls_id, score, x0, y0,
+    x1, y1], cls_id -1 for suppressed/background; greedy per-class NMS
+    (host-side like contrib.box_nms — dynamic control flow)."""
+    import numpy as np
+    cls_prob = np.asarray(cls_prob)                # (N, num_cls+1, A)
+    loc_pred = np.asarray(loc_pred)                # (N, A*4)
+    anc = np.asarray(anchor).reshape(-1, 4)        # (A, 4)
+    N, _, A = cls_prob.shape
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    out = np.full((N, A, 6), -1.0, np.float32)
+    for n in range(N):
+        loc = loc_pred[n].reshape(A, 4)
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = np.exp(loc[:, 2] * variances[2]) * aw
+        h = np.exp(loc[:, 3] * variances[3]) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], 1)
+        if clip:
+            boxes = np.clip(boxes, 0.0, 1.0)
+        # reference emits a candidate per (anchor, non-background class)
+        # above threshold — NOT just the argmax class — then NMS; output
+        # keeps at most A rows (the op's fixed (N, A, 6) shape)
+        n_cls = cls_prob.shape[1] - 1
+        cand_cls, cand_anchor = np.nonzero(
+            cls_prob[n, 1:] >= max(threshold, 1e-12))
+        cand_score = cls_prob[n, 1 + cand_cls, cand_anchor]
+        order = np.argsort(-cand_score)
+        if nms_topk > 0:
+            order = order[:nms_topk]
+        alive = np.ones(len(order), bool)
+        row = 0
+        for oi, i in enumerate(order):
+            if not alive[oi] or row >= A:
+                continue
+            bi = boxes[cand_anchor[i]]
+            out[n, row] = [cand_cls[i], cand_score[i], *bi]
+            row += 1
+            for oj in range(oi + 1, len(order)):
+                j = order[oj]
+                if not alive[oj]:
+                    continue
+                if not force_suppress and cand_cls[j] != cand_cls[i]:
+                    continue
+                bj = boxes[cand_anchor[j]]
+                tl = np.maximum(bi[:2], bj[:2])
+                br = np.minimum(bi[2:], bj[2:])
+                inter = np.prod(np.maximum(br - tl, 0))
+                a_i = np.prod(bi[2:] - bi[:2])
+                a_j = np.prod(bj[2:] - bj[:2])
+                if inter / max(a_i + a_j - inter, 1e-12) > nms_threshold:
+                    alive[oj] = False
+            alive[oi] = False
+    return out
+
+
+@register("contrib.PSROIPooling")
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1, pooled_size=7,
+                   group_size=0):
+    """Position-sensitive ROI pooling (reference contrib/psroi_pooling.cc,
+    R-FCN): data (N, output_dim*g*g, H, W); each (ph, pw) output bin average-
+    pools from its OWN channel group.  rois (R, 5) [batch, x0, y0, x1, y1]
+    in image coords."""
+    jnp = _jnp()
+    import jax
+    g = int(group_size) if group_size else int(pooled_size)
+    P = int(pooled_size)
+    N, C, H, W = data.shape
+    D = int(output_dim)
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x0, y0, x1, y1 = (roi[1] * spatial_scale, roi[2] * spatial_scale,
+                          roi[3] * spatial_scale, roi[4] * spatial_scale)
+        rw = jnp.maximum(x1 - x0, 0.1)
+        rh = jnp.maximum(y1 - y0, 0.1)
+        bw, bh = rw / P, rh / P
+        img = data[b]                                    # (C, H, W)
+
+        def bin_val(ph, pw):
+            ys0, ys1 = y0 + ph * bh, y0 + (ph + 1) * bh
+            xs0, xs1 = x0 + pw * bw, x0 + (pw + 1) * bw
+            my = ((ys >= jnp.floor(ys0)) & (ys < jnp.ceil(ys1))) \
+                .astype(jnp.float32)
+            mx_ = ((xs >= jnp.floor(xs0)) & (xs < jnp.ceil(xs1))) \
+                .astype(jnp.float32)
+            m = my[:, None] * mx_[None, :]
+            cnt = jnp.maximum(m.sum(), 1.0)
+            gy = jnp.clip((ph * g) // P, 0, g - 1)
+            gx = jnp.clip((pw * g) // P, 0, g - 1)
+            chan = (jnp.arange(D) * g + gy) * g + gx     # (D,)
+            grp = img[chan]                              # (D, H, W)
+            return (grp * m[None]).sum((1, 2)) / cnt     # (D,)
+
+        rows = jnp.stack([jnp.stack([bin_val(ph, pw) for pw in range(P)], -1)
+                          for ph in range(P)], -2)       # (D, P, P)
+        return rows
+
+    return jax.vmap(one_roi)(rois)                       # (R, D, P, P)
+
+
+@register("contrib.DeformableConvolution")
+def _deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                            stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                            num_filter=0, num_group=1,
+                            num_deformable_group=1, no_bias=False):
+    """Deformable conv v1 (reference contrib/deformable_convolution.cc):
+    per-position learned offsets shift each kernel tap's sampling point;
+    taps are read with bilinear interpolation, then contracted with the
+    weights — implemented as gather-into-patches + one matmul (MXU)."""
+    jnp = _jnp()
+    if num_group != 1 or num_deformable_group != 1:
+        from ..base import MXNetError
+        raise MXNetError("DeformableConvolution: num_group=1 only on TPU")
+    kh, kw = kernel
+    sh, sw = stride if not isinstance(stride, int) else (stride, stride)
+    ph, pw = pad if not isinstance(pad, int) else (pad, pad)
+    dh, dw = dilate if not isinstance(dilate, int) else (dilate, dilate)
+    N, C, H, W = data.shape
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    x = jnp.pad(data, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    Hp, Wp = H + 2 * ph, W + 2 * pw
+    base_y = jnp.arange(Ho) * sh
+    base_x = jnp.arange(Wo) * sw
+    # offsets: (N, 2*kh*kw, Ho, Wo), pairs ordered (y, x) per tap
+    off = offset.reshape(N, kh * kw, 2, Ho, Wo)
+
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            t = i * kw + j
+            py = base_y[None, :, None] + i * dh + off[:, t, 0]   # (N,Ho,Wo)
+            px = base_x[None, None, :] + j * dw + off[:, t, 1]
+            y0 = jnp.floor(py)
+            x0 = jnp.floor(px)
+            wy = py - y0
+            wx = px - x0
+
+            def tap(yy, xx):
+                yi = jnp.clip(yy, 0, Hp - 1).astype(jnp.int32)
+                xi = jnp.clip(xx, 0, Wp - 1).astype(jnp.int32)
+                inb = ((yy >= 0) & (yy <= Hp - 1) & (xx >= 0)
+                       & (xx <= Wp - 1)).astype(x.dtype)
+                v = x[jnp.arange(N)[:, None, None, None],
+                      jnp.arange(C)[None, :, None, None],
+                      yi[:, None], xi[:, None]]
+                return v * inb[:, None]
+
+            v = (tap(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+                 + tap(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+                 + tap(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+                 + tap(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+            cols.append(v)                               # (N, C, Ho, Wo)
+    patches = jnp.stack(cols, axis=2)                    # (N, C, kh*kw, Ho, Wo)
+    patches = patches.reshape(N, C * kh * kw, Ho * Wo)
+    wmat = weight.reshape(weight.shape[0], -1)           # (F, C*kh*kw)
+    out = jnp.einsum("fk,nkp->nfp", wmat, patches) \
+        .reshape(N, weight.shape[0], Ho, Wo)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out

@@ -1,0 +1,225 @@
+"""Contrib op tail: fft, count_sketch, ctc_loss, SSD multibox family,
+PSROIPooling, DeformableConvolution, gluon.contrib.nn layers.
+
+Reference anchors: src/operator/contrib/{fft,count_sketch,multibox_prior,
+multibox_target,multibox_detection,psroi_pooling,deformable_convolution}.cc,
+src/operator/nn/ctc_loss.cc, python/mxnet/gluon/contrib/nn/basic_layers.py.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_fft_ifft_roundtrip_and_values():
+    r = np.random.RandomState(0)
+    x = r.randn(3, 8).astype(np.float32)
+    f = nd.contrib.fft(nd.array(x)).asnumpy()
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f[:, 0::2], ref.real, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(f[:, 1::2], ref.imag, rtol=1e-4, atol=1e-5)
+    # reference ifft is unnormalized (cuFFT): ifft(fft(x)) == n * x
+    back = nd.contrib.ifft(nd.array(f)).asnumpy()
+    np.testing.assert_allclose(back, 8 * x, rtol=1e-4, atol=1e-4)
+
+
+def test_count_sketch_projection():
+    d = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)
+    h = nd.array(np.array([0, 1, 0, 2], np.float32))
+    s = nd.array(np.array([1, -1, 1, 1], np.float32))
+    out = nd.contrib.count_sketch(nd.array(d), h, s, out_dim=3).asnumpy()
+    np.testing.assert_allclose(out, [[1 + 3, -2, 4]])
+
+
+def test_ctc_loss_matches_gluon_and_grad():
+    T, N, C = 12, 2, 5
+    r = np.random.RandomState(1)
+    logits = nd.array(r.randn(T, N, C).astype(np.float32))
+    label = nd.array(np.array([[1, 2, 0], [3, 1, 2]], np.float32))
+    loss = nd.ctc_loss(logits, label)
+    assert loss.shape == (N,)
+    assert (loss.asnumpy() > 0).all()
+    # imperative gradient flows (op registered differentiable via optax)
+    logits.attach_grad()
+    with autograd.record():
+        l = nd.ctc_loss(logits, label).sum()
+    l.backward()
+    g = logits.grad.asnumpy()
+    assert np.abs(g).max() > 0 and np.isfinite(g).all()
+
+
+def test_ctc_loss_label_lengths_only():
+    """Passing ONLY label_lengths must not shift it into the data_lengths
+    slot (None positionals are dropped by op wrappers)."""
+    import optax
+    from mxnet_tpu.gluon import loss as gloss
+    r = np.random.RandomState(7)
+    T, N, C = 12, 1, 5
+    pred = r.randn(N, T, C).astype(np.float32)       # NTC gluon layout
+    label = np.array([[1, 2, 2]], np.float32)
+    ll = np.array([2], np.float32)                    # only first 2 labels
+    out = gloss.CTCLoss()(nd.array(pred), nd.array(label), None,
+                          nd.array(ll)).asnumpy()
+    ref = optax.ctc_loss(pred, np.zeros((N, T), np.float32),
+                         label.astype(np.int32),
+                         (np.arange(3)[None] >= ll[:, None])
+                         .astype(np.float32), blank_id=0)
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-4)
+
+
+def test_multibox_target_pad_rows_cannot_steal_anchor0():
+    """A pad row (cls=-1) must not unassign or claim anchor 0 even when a
+    real gt's best anchor IS anchor 0."""
+    # anchors: anchor 0 exactly overlaps the gt, others far away
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.3, 0.3],
+                                  [0.7, 0.7, 0.9, 0.9]]], np.float32))
+    label = nd.array(np.array(
+        [[[2, 0.0, 0.0, 0.3, 0.3], [-1, 0, 0, 0, 0]]], np.float32))
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, label, nd.zeros((1, 4, 2)))
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 3.0                    # class 2 → target 3 at anchor 0
+    assert ct[1] == 0.0                    # far anchor stays background
+    assert np.isfinite(loc_t.asnumpy()).all()
+    # the matched anchor's offsets are ~0 (exact overlap), not degenerate
+    np.testing.assert_allclose(loc_t.asnumpy()[0][:4], 0.0, atol=1e-5)
+
+
+def test_multibox_detection_emits_secondary_classes():
+    """An anchor confident for two classes yields candidates for both
+    (reference emits one candidate per non-background class, not argmax)."""
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5]]], np.float32))
+    cls_prob = np.zeros((1, 3, 1), np.float32)
+    cls_prob[0, 1, 0] = 0.45                # class 0
+    cls_prob[0, 2, 0] = 0.44                # class 1
+    det = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.zeros((1, 4)), anchors).asnumpy()[0]
+    kept = det[det[:, 0] >= 0]
+    assert len(kept) == 1                   # same-anchor: capped by A rows
+    # without force_suppress, different classes don't suppress each other —
+    # but output is capped at A rows; widen A to see both
+    anchors2 = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                   [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    cls_prob2 = np.zeros((1, 3, 2), np.float32)
+    cls_prob2[0, 1, 0] = 0.45
+    cls_prob2[0, 2, 0] = 0.44
+    det2 = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob2), nd.zeros((1, 8)), anchors2).asnumpy()[0]
+    kept2 = det2[det2[:, 0] >= 0]
+    assert sorted(kept2[:, 0].tolist()) == [0.0, 1.0]
+
+
+def test_multibox_prior_layout():
+    feat = nd.zeros((1, 8, 4, 5))
+    anchors = nd.contrib.MultiBoxPrior(feat, sizes=(0.4, 0.2),
+                                       ratios=(1, 2, 0.5))
+    # A = sizes + ratios - 1 = 4 per pixel
+    assert anchors.shape == (1, 4 * 5 * 4, 4)
+    a = anchors.asnumpy()[0].reshape(4, 5, 4, 4)
+    # first anchor at pixel (0,0): size .4, ratio 1, centered (0.5/5, 0.5/4)
+    cx, cy = 0.5 / 5, 0.5 / 4
+    np.testing.assert_allclose(a[0, 0, 0],
+                               [cx - 0.2, cy - 0.2, cx + 0.2, cy + 0.2],
+                               atol=1e-6)
+
+
+def test_multibox_target_matching():
+    feat = nd.zeros((1, 4, 4, 4))
+    anchors = nd.contrib.MultiBoxPrior(feat, sizes=(0.3,), ratios=(1,))
+    A = anchors.shape[1]
+    # one gt box matching the anchor near (0.375, 0.375)
+    label = nd.array(np.array(
+        [[[1, 0.25, 0.25, 0.5, 0.5], [-1, 0, 0, 0, 0]]], np.float32))
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, label, nd.zeros((1, 3, A)))
+    ct = cls_t.asnumpy()[0]
+    assert (ct > 0).sum() >= 1              # at least the forced best anchor
+    assert set(np.unique(ct)) <= {0.0, 2.0}  # class id 1 → target 2 (1+cls)
+    lm = loc_m.asnumpy()[0].reshape(A, 4)
+    assert ((lm.sum(1) > 0) == (ct > 0)).all()  # mask aligns with matches
+
+
+def test_multibox_detection_decodes_and_nms():
+    feat = nd.zeros((1, 4, 2, 2))
+    # two sizes per pixel → same-center boxes with IoU 0.69: NMS fodder
+    anchors = nd.contrib.MultiBoxPrior(feat, sizes=(0.5, 0.6), ratios=(1,))
+    A = anchors.shape[1]
+    cls_prob = np.zeros((1, 2, A), np.float32)
+    cls_prob[0, 0] = 0.1
+    cls_prob[0, 1] = 0.9                     # all anchors confident class 0
+    det = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.zeros((1, A * 4)), anchors,
+        nms_threshold=0.3).asnumpy()[0]
+    kept = det[det[:, 0] >= 0]
+    assert len(kept) == A // 2               # one survivor per pixel
+    np.testing.assert_allclose(kept[0, 1], 0.9, atol=1e-6)
+
+
+def test_psroi_pooling_position_sensitivity():
+    """Each output bin must read its own channel group: constant-per-channel
+    input makes output bin (d, ph, pw) equal the value of its group chan."""
+    D, g = 2, 2
+    C = D * g * g
+    data = np.zeros((1, C, 8, 8), np.float32)
+    for c in range(C):
+        data[0, c] = c
+    rois = nd.array(np.array([[0, 0, 0, 8, 8]], np.float32))
+    out = nd.contrib.PSROIPooling(nd.array(data), rois, output_dim=D,
+                                  pooled_size=g, group_size=g).asnumpy()
+    for d in range(D):
+        for py in range(g):
+            for px in range(g):
+                expect = (d * g + py) * g + px
+                np.testing.assert_allclose(out[0, d, py, px], expect)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    r = np.random.RandomState(2)
+    x = nd.array(r.randn(2, 3, 10, 10).astype(np.float32))
+    w = nd.array(r.randn(5, 3, 3, 3).astype(np.float32))
+    off = nd.zeros((2, 18, 8, 8))
+    out = nd.contrib.DeformableConvolution(x, off, w, kernel=(3, 3),
+                                           num_filter=5)
+    ref = nd.Convolution(x, w, kernel=(3, 3), num_filter=5, no_bias=True)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_shift():
+    """Constant integer offset == sampling the shifted image."""
+    r = np.random.RandomState(3)
+    x_np = r.randn(1, 2, 9, 9).astype(np.float32)
+    w = nd.array(r.randn(3, 2, 3, 3).astype(np.float32))
+    off_np = np.zeros((1, 18, 7, 7), np.float32)
+    off_np[:, 0::2] = 1.0                    # shift all taps down 1 px
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x_np), nd.array(off_np), w, kernel=(3, 3), num_filter=3)
+    shifted = np.pad(x_np, ((0, 0), (0, 0), (0, 1), (0, 0)))[:, :, 1:, :]
+    ref = nd.Convolution(nd.array(shifted), w, kernel=(3, 3), num_filter=3,
+                         no_bias=True)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_sync_batch_norm_and_contrib_layers():
+    from mxnet_tpu.gluon.contrib import nn as cnn
+    from mxnet_tpu.gluon import nn
+    sbn = cnn.SyncBatchNorm(in_channels=4, num_devices=8)
+    sbn.initialize()
+    x = nd.array(np.random.RandomState(4).randn(2, 4, 3, 3)
+                 .astype(np.float32))
+    with autograd.record():
+        y = sbn(x)
+    # training-mode BN: per-channel batch stats normalize to ~0 mean
+    m = y.asnumpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, 0, atol=1e-5)
+
+    ident = cnn.Identity()
+    np.testing.assert_array_equal(ident(x).asnumpy(), x.asnumpy())
+
+    conc = cnn.Concurrent(axis=1)
+    conc.add(cnn.Identity())
+    conc.add(cnn.Identity())
+    assert conc(x).shape == (2, 8, 3, 3)
