@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""BERT-base MLM pretraining step — the BASELINE flagship config
+(north star: ≥45% MFU; reference workflow: GluonNLP run_pretraining over
+the contrib.interleaved attention ops).
+
+Synthetic masked-LM batches drive the full train step: masked tokens,
+valid_length padding masks, fused attention (Pallas flash on TPU), bf16
+matmuls, fused Adam — all inside ONE jitted SPMD program
+(``parallel.TrainStep``).  ``--tp N`` applies megatron tensor-parallel
+shardings over an N-way mesh axis.  Prints samples/sec and (optionally)
+the MFU estimate the bench harness uses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def synthetic_mlm_batch(rng, batch, seq_len, vocab, mask_id=103,
+                        mask_frac=0.15):
+    tokens = rng.randint(5, vocab, (batch, seq_len)).astype(np.float32)
+    valid_length = rng.randint(seq_len // 2, seq_len + 1,
+                               (batch,)).astype(np.float32)
+    labels = tokens.copy()
+    mask = rng.rand(batch, seq_len) < mask_frac
+    mask &= np.arange(seq_len)[None] < valid_length[:, None]
+    tokens[mask] = mask_id
+    weights = mask.astype(np.float32)
+    return tokens, valid_length, labels, weights
+
+
+def run(num_layers=12, units=768, heads=12, batch=32, seq_len=128,
+        vocab=30522, steps=8, warmup=2, dp=1, tp=1, lr=1e-4, log=True):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import bert
+    from mxnet_tpu.parallel import DeviceMesh, TrainStep
+
+    mx.random.seed(0)
+    model = bert.BERTModel(vocab_size=vocab, num_layers=num_layers,
+                           units=units, hidden_size=4 * units,
+                           num_heads=heads, max_length=seq_len)
+    model.initialize(mx.init.Normal(0.02))
+    if tp > 1:
+        bert.apply_tp_shardings(model)
+    import jax
+    if dp * tp > 1:
+        mesh = DeviceMesh(shape=(dp, tp), axis_names=("dp", "tp"))
+    else:
+        mesh = DeviceMesh(devices=jax.devices()[:1])
+
+    def mlm_loss(out, packed):
+        # BERTModel returns (sequence, pooled, decoder scores); packed
+        # carries labels ++ weights along dim 1
+        out = out[2]                             # (B, L, vocab)
+        B, L = packed.shape[0], packed.shape[1] // 2
+        labels = packed[:, :L]
+        weights = packed[:, L:]
+        logp = mx.nd.log_softmax(out, axis=-1)
+        ll = mx.nd.pick(logp, labels, axis=-1)
+        return -(ll * weights).sum() / mx.nd.maximum(
+            weights.sum(), mx.nd.ones_like(weights.sum()))
+
+    step = TrainStep(model, mlm_loss, "adam",
+                     {"learning_rate": lr, "multi_precision": True},
+                     mesh=mesh)
+    rng = np.random.RandomState(0)
+    tokens, vl, labels, weights = synthetic_mlm_batch(rng, batch, seq_len,
+                                                      vocab)
+    data = mx.nd.array(tokens)
+    packed = mx.nd.array(np.concatenate([labels, weights], axis=1))
+
+    for _ in range(warmup):
+        step(data, packed).asnumpy()
+    t0 = time.time()
+    losses = [float(step(data, packed).asnumpy()) for _ in range(steps)]
+    dt = time.time() - t0
+    rec = {"samples_per_sec": round(steps * batch / dt, 2),
+           "first_loss": round(losses[0], 4),
+           "last_loss": round(losses[-1], 4), "dp": dp, "tp": tp}
+    if log:
+        print(json.dumps(rec))
+    return rec
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--layers", type=int, default=12)
+    p.add_argument("--units", type=int, default=768)
+    p.add_argument("--heads", type=int, default=12)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    a = p.parse_args()
+    run(a.layers, a.units, a.heads, a.batch, a.seq_len, steps=a.steps,
+        dp=a.dp, tp=a.tp)
+
+
+if __name__ == "__main__":
+    main()

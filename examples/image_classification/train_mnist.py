@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Gluon LeNet on MNIST — BASELINE config 1, the one-line ctx swap demo
+(reference example/image-classification/train_mnist.py + gluon/mnist.py).
+
+``--ctx tpu`` vs ``--ctx cpu`` is the whole porting story: same script,
+same numerics contract.  Falls back to synthetic digits when the MNIST
+files are absent (zero-egress sandboxes), so the script is always runnable.
+Prints one JSON line per epoch: {"epoch": e, "loss": …, "acc": …,
+"samples_per_sec": …}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def lenet():
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(20, kernel_size=5, activation="relu"))
+    net.add(nn.MaxPool2D(pool_size=2, strides=2))
+    net.add(nn.Conv2D(50, kernel_size=5, activation="relu"))
+    net.add(nn.MaxPool2D(pool_size=2, strides=2))
+    net.add(nn.Dense(500, activation="relu"))
+    net.add(nn.Dense(10))
+    return net
+
+
+def load_data(batch_size, synthetic_samples=512):
+    """MNISTIter when the idx files exist; synthetic digit blobs otherwise."""
+    import mxnet_tpu as mx
+    path = os.environ.get("MXNET_MNIST_DIR", "data/mnist")
+    img = os.path.join(path, "train-images-idx3-ubyte")
+    if os.path.exists(img):
+        return mx.io.MNISTIter(image=img,
+                               label=os.path.join(
+                                   path, "train-labels-idx1-ubyte"),
+                               batch_size=batch_size, shuffle=True)
+    rng = np.random.RandomState(0)
+    x = rng.rand(synthetic_samples, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, (synthetic_samples,)).astype(np.float32)
+    # make classes separable so accuracy moves: class k brightens row k
+    for k in range(10):
+        x[y == k, 0, 2 * k:2 * k + 2, :] += 2.0
+    return mx.io.NDArrayIter(data=x, label=y, batch_size=batch_size,
+                             shuffle=True)
+
+
+def run(ctx_name="cpu", epochs=2, batch_size=64, lr=0.05, hybridize=True,
+        log=True, synthetic_samples=512):
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+
+    ctx = mx.tpu() if ctx_name == "tpu" else mx.cpu()
+    mx.random.seed(42)
+    net = lenet()
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    if hybridize:
+        net.hybridize(static_alloc=True)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    history = []
+    for epoch in range(epochs):
+        data_iter = load_data(batch_size, synthetic_samples)
+        metric.reset()
+        total_loss, nbatch, t0 = 0.0, 0, time.time()
+        for batch in data_iter:
+            x = batch.data[0].as_in_context(ctx)
+            y = batch.label[0].as_in_context(ctx)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            total_loss += float(loss.mean().asnumpy())
+            metric.update([y], [out])
+            nbatch += 1
+        dt = time.time() - t0
+        rec = {"epoch": epoch, "loss": round(total_loss / max(nbatch, 1), 4),
+               "acc": round(metric.get()[1], 4),
+               "samples_per_sec": round(nbatch * batch_size / dt, 1)}
+        history.append(rec)
+        if log:
+            print(json.dumps(rec))
+    return history
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--ctx", default="cpu", choices=["cpu", "tpu"])
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--no-hybridize", action="store_true")
+    a = p.parse_args()
+    run(a.ctx, a.epochs, a.batch_size, a.lr, not a.no_hybridize)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""ResNet-50 synthetic-ImageNet training throughput — BASELINE config 2
+(reference example/image-classification/train_imagenet.py with
+benchmark=1, i.e. synthetic data).
+
+Runs the model-zoo ResNet through the fused SPMD ``parallel.TrainStep``
+(bf16 matmuls under mx.amp if requested) and reports images/sec.  On a
+pod slice, pass ``--dp N`` to shard the batch over N devices.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def run(model="resnet50_v1", batch_size=32, image_size=224, steps=12,
+        warmup=3, dp=1, classes=1000, amp=False, log=True):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import DeviceMesh, TrainStep
+
+    if amp:
+        mx.amp.init()
+    mx.random.seed(0)
+    net = vision.get_model(model, classes=classes)
+    net.initialize(mx.init.Xavier())
+    import jax
+    mesh = DeviceMesh(devices=jax.devices()[:1]) if dp <= 1 else \
+        DeviceMesh(shape=(dp,), axis_names=("dp",))
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(batch_size, 3, image_size, image_size)
+                    .astype(np.float32))
+    y = mx.nd.array(rng.randint(0, classes, (batch_size,))
+                    .astype(np.float32))
+    for _ in range(warmup):
+        step(x, y).asnumpy()                     # compile + warm
+    t0 = time.time()
+    for _ in range(steps):
+        loss = step(x, y)
+    loss.asnumpy()                               # sync
+    dt = time.time() - t0
+    rec = {"model": model, "batch_size": batch_size,
+           "images_per_sec": round(steps * batch_size / dt, 2),
+           "amp": amp, "dp": dp}
+    if log:
+        print(json.dumps(rec))
+    return rec
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50_v1")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--amp", action="store_true")
+    a = p.parse_args()
+    run(a.model, a.batch_size, a.image_size, a.steps, dp=a.dp, amp=a.amp)
+
+
+if __name__ == "__main__":
+    main()

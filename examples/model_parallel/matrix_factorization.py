@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Model-parallel matrix factorization
+(reference example/model-parallel/matrix_factorization/ — the group2ctx
+demo).
+
+The TPU-native translation of ``group2ctx``: instead of pinning symbol
+groups to devices and letting PlaceDevice insert _CrossDeviceCopy, the two
+embedding tables carry ``Parameter.sharding`` hints over a 2-way 'mp' mesh
+axis and GSPMD places the computation — same model-parallel semantics,
+zero manual copies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def run(num_users=512, num_items=512, factor=64, batch=256, steps=20,
+        mp=1, lr=0.05, log=True):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DeviceMesh, TrainStep
+
+    class MF(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.user_embed = nn.Embedding(num_users, factor)
+                self.item_embed = nn.Embedding(num_items, factor)
+
+        def hybrid_forward(self, F, pair):
+            u = self.user_embed(F.slice_axis(pair, axis=1, begin=0, end=1)
+                                .reshape((-1,)))
+            v = self.item_embed(F.slice_axis(pair, axis=1, begin=1, end=2)
+                                .reshape((-1,)))
+            return F.sum(u * v, axis=-1)
+
+    mx.random.seed(2)
+    net = MF()
+    net.initialize(mx.init.Normal(0.05))
+    if mp > 1:
+        # model parallel: factor dim sharded — each device holds a slice
+        # of BOTH tables (the reference pins one table per GPU; sharding
+        # the factor axis is the mesh-native equivalent placement)
+        net.user_embed.weight.sharding = (None, "mp")
+        net.item_embed.weight.sharding = (None, "mp")
+        mesh = DeviceMesh(shape=(mp,), axis_names=("mp",),
+                          devices=__import__("jax").devices()[:mp])
+    else:
+        mesh = DeviceMesh(devices=__import__("jax").devices()[:1])
+
+    step = TrainStep(net, lambda out, y: gluon.loss.L2Loss()(out, y),
+                     "sgd", {"learning_rate": lr}, mesh=mesh)
+    rng = np.random.RandomState(0)
+    users = rng.randint(0, num_users, (batch,))
+    items = rng.randint(0, num_items, (batch,))
+    truth = ((users % 7) * (items % 5) % 5).astype(np.float32)
+    pairs = mx.nd.array(np.stack([users, items], 1).astype(np.float32))
+    ratings = mx.nd.array(truth)
+
+    t0, losses = time.time(), []
+    for _ in range(steps):
+        losses.append(float(step(pairs, ratings).asnumpy()))
+    rec = {"first_loss": round(losses[0], 4),
+           "last_loss": round(losses[-1], 4), "mp": mp,
+           "steps_per_sec": round(steps / (time.time() - t0), 2)}
+    if log:
+        print(json.dumps(rec))
+    return rec
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mp", type=int, default=1)
+    p.add_argument("--steps", type=int, default=20)
+    a = p.parse_args()
+    run(mp=a.mp, steps=a.steps)
+
+
+if __name__ == "__main__":
+    main()

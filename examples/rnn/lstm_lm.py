@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Word-level LSTM language model (reference example/rnn/word_lm/train.py)
+on synthetic text: Embedding → stacked gluon.rnn.LSTM → Dense decoder,
+truncated-BPTT batching, perplexity metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def make_corpus(rng, vocab, length):
+    """Markov-ish synthetic corpus so the LM has structure to learn."""
+    data = np.zeros(length, np.int64)
+    for i in range(1, length):
+        data[i] = (data[i - 1] * 7 + rng.randint(0, 3)) % vocab
+    return data
+
+
+def batchify(data, batch_size):
+    n = len(data) // batch_size
+    return data[:n * batch_size].reshape(batch_size, n).T  # (T, B)
+
+
+def run(vocab=64, emb=32, hidden=64, layers=2, bptt=16, batch_size=8,
+        epochs=2, lr=1.0, corpus_len=4096, log=True):
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn, rnn
+
+    class RNNModel(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed = nn.Embedding(vocab, emb)
+                self.lstm = rnn.LSTM(hidden, num_layers=layers)
+                self.decoder = nn.Dense(vocab, flatten=False)
+
+        def hybrid_forward(self, F, x, state=None):
+            e = self.embed(x)                      # (T, B, emb)
+            if state is None:
+                out = self.lstm(e)
+            else:
+                out, state = self.lstm(e, state)
+            return self.decoder(out), state
+
+    mx.random.seed(1)
+    net = RNNModel()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = np.random.RandomState(0)
+    corpus = batchify(make_corpus(rng, vocab, corpus_len), batch_size)
+    history = []
+    for epoch in range(epochs):
+        total, count, t0 = 0.0, 0, time.time()
+        for i in range(0, corpus.shape[0] - 1 - bptt, bptt):
+            x = mx.nd.array(corpus[i:i + bptt].astype(np.float32))
+            y = mx.nd.array(corpus[i + 1:i + bptt + 1].astype(np.float32))
+            with autograd.record():
+                out, _ = net(x)
+                loss = loss_fn(out.reshape((-1, vocab)), y.reshape((-1,)))
+            loss.backward()
+            trainer.step(bptt * batch_size)
+            total += float(loss.mean().asnumpy())
+            count += 1
+        ppl = math.exp(min(total / max(count, 1), 20))
+        rec = {"epoch": epoch, "perplexity": round(ppl, 2),
+               "tokens_per_sec": round(
+                   count * bptt * batch_size / (time.time() - t0), 1)}
+        history.append(rec)
+        if log:
+            print(json.dumps(rec))
+    return history
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--bptt", type=int, default=16)
+    p.add_argument("--batch-size", type=int, default=8)
+    a = p.parse_args()
+    run(epochs=a.epochs, bptt=a.bptt, batch_size=a.batch_size)
+
+
+if __name__ == "__main__":
+    main()

@@ -58,16 +58,16 @@ class Identity(HybridBlock):
 
 class Concurrent(HybridSequential):
     """Run children on the same input and concat outputs along ``axis``
-    (reference contrib/nn :: Concurrent)."""
+    (reference contrib/nn :: Concurrent).  Implemented via hybrid_forward
+    so hybridize()/export() work (HybridConcurrent contract)."""
 
     def __init__(self, axis=-1, **kwargs):
         super().__init__(**kwargs)
         self._axis = axis
 
-    def forward(self, x):
-        from ... import ndarray as nd
+    def hybrid_forward(self, F, x):
         outs = [child(x) for child in self._children.values()]
-        return nd.concat(*outs, dim=self._axis)
+        return F.concat(*outs, dim=self._axis)
 
 
 HybridConcurrent = Concurrent

@@ -143,10 +143,14 @@ class CTCLoss(Loss):
     """
 
     def __init__(self, layout="NTC", label_layout="NT", weight=None,
-                 **kwargs):
+                 blank_label="last", **kwargs):
+        # upstream gluon CTCLoss fixes the blank at index C-1 ('last');
+        # blank_label is exposed as an extension for 'first'-convention
+        # checkpoints (labels then 1-based, 0-padded)
         super().__init__(weight, 0, **kwargs)
         self._layout = layout
         self._label_layout = label_layout
+        self._blank_label = blank_label
 
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
@@ -165,7 +169,7 @@ class CTCLoss(Loss):
         loss = F.ctc_loss(pred, label, pred_lengths, label_lengths,
                           use_data_lengths=pred_lengths is not None,
                           use_label_lengths=label_lengths is not None,
-                          blank_label="first")
+                          blank_label=self._blank_label)
         return _apply_weighting(F, loss, self._weight, sample_weight)
 
 

@@ -381,8 +381,9 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
     acy = (anc[:, 1] + anc[:, 3]) / 2
 
     from .contrib import _box_iou                            # shared geometry
+    mine = float(negative_mining_ratio) > 0
 
-    def one_sample(lab):
+    def one_sample(lab, pred):
         cls = lab[:, 0]
         boxes = lab[:, 1:5]
         valid = cls >= 0                                     # (G,)
@@ -415,11 +416,29 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
             jnp.log(gw / aw) / variances[2],
             jnp.log(gh / ah) / variances[3]], axis=-1)       # (A, 4)
         m = assigned.astype(anc.dtype)[:, None]
-        cls_t = jnp.where(assigned, cls[gt_idx] + 1, 0.0)
+        if mine:
+            # hard negative mining (reference multibox_target.cc): rank
+            # unmatched low-overlap anchors by their best non-background
+            # class prob; keep ratio*num_pos (≥ minimum) hardest as
+            # background 0, the rest become ignore_label
+            neg_score = jnp.max(pred[1:], axis=0)            # (A,)
+            candidate = (~assigned) & (best_iou
+                                       < negative_mining_thresh)
+            num_pos = jnp.sum(assigned)
+            num_neg = jnp.maximum(
+                negative_mining_ratio * num_pos.astype(jnp.float32),
+                float(minimum_negative_samples))
+            ranked = jnp.argsort(jnp.argsort(
+                -jnp.where(candidate, neg_score, -jnp.inf)))  # rank per anchor
+            selected_neg = candidate & (ranked < num_neg)
+            cls_t = jnp.where(
+                assigned, cls[gt_idx] + 1,
+                jnp.where(selected_neg, 0.0, float(ignore_label)))
+        else:
+            cls_t = jnp.where(assigned, cls[gt_idx] + 1, 0.0)
         return (loc * m).reshape(-1), jnp.repeat(m, 4, 1).reshape(-1), cls_t
 
-    loc_t, loc_m, cls_t = jax.vmap(one_sample)(label)
-    del cls_pred  # reference uses it only for negative mining (off here)
+    loc_t, loc_m, cls_t = jax.vmap(one_sample)(label, cls_pred)
     return loc_t, loc_m, cls_t
 
 
@@ -453,36 +472,36 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
         # reference emits a candidate per (anchor, non-background class)
         # above threshold — NOT just the argmax class — then NMS; output
         # keeps at most A rows (the op's fixed (N, A, 6) shape)
-        n_cls = cls_prob.shape[1] - 1
         cand_cls, cand_anchor = np.nonzero(
             cls_prob[n, 1:] >= max(threshold, 1e-12))
         cand_score = cls_prob[n, 1 + cand_cls, cand_anchor]
         order = np.argsort(-cand_score)
         if nms_topk > 0:
             order = order[:nms_topk]
+        c_box = boxes[cand_anchor[order]]            # (K, 4) by rank
+        c_cls = cand_cls[order]
+        c_score = cand_score[order]
+        c_area = np.prod(np.maximum(c_box[:, 2:] - c_box[:, :2], 0), axis=1)
         alive = np.ones(len(order), bool)
         row = 0
-        for oi, i in enumerate(order):
-            if not alive[oi] or row >= A:
+        for oi in range(len(order)):
+            if not alive[oi]:
                 continue
-            bi = boxes[cand_anchor[i]]
-            out[n, row] = [cand_cls[i], cand_score[i], *bi]
+            out[n, row] = [c_cls[oi], c_score[oi], *c_box[oi]]
             row += 1
-            for oj in range(oi + 1, len(order)):
-                j = order[oj]
-                if not alive[oj]:
-                    continue
-                if not force_suppress and cand_cls[j] != cand_cls[i]:
-                    continue
-                bj = boxes[cand_anchor[j]]
-                tl = np.maximum(bi[:2], bj[:2])
-                br = np.minimum(bi[2:], bj[2:])
-                inter = np.prod(np.maximum(br - tl, 0))
-                a_i = np.prod(bi[2:] - bi[:2])
-                a_j = np.prod(bj[2:] - bj[:2])
-                if inter / max(a_i + a_j - inter, 1e-12) > nms_threshold:
-                    alive[oj] = False
-            alive[oi] = False
+            if row >= A:
+                break
+            # vectorized suppression of lower-ranked overlaps
+            rest = slice(oi + 1, None)
+            tl = np.maximum(c_box[oi, :2], c_box[rest, :2])
+            br = np.minimum(c_box[oi, 2:], c_box[rest, 2:])
+            inter = np.prod(np.maximum(br - tl, 0), axis=1)
+            iou = inter / np.maximum(c_area[oi] + c_area[rest] - inter,
+                                     1e-12)
+            hit = iou > nms_threshold
+            if not force_suppress:
+                hit &= c_cls[rest] == c_cls[oi]
+            alive[rest] &= ~hit
     return out
 
 

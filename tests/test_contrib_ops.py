@@ -60,8 +60,8 @@ def test_ctc_loss_label_lengths_only():
     pred = r.randn(N, T, C).astype(np.float32)       # NTC gluon layout
     label = np.array([[1, 2, 2]], np.float32)
     ll = np.array([2], np.float32)                    # only first 2 labels
-    out = gloss.CTCLoss()(nd.array(pred), nd.array(label), None,
-                          nd.array(ll)).asnumpy()
+    out = gloss.CTCLoss(blank_label="first")(
+        nd.array(pred), nd.array(label), None, nd.array(ll)).asnumpy()
     ref = optax.ctc_loss(pred, np.zeros((N, T), np.float32),
                          label.astype(np.int32),
                          (np.arange(3)[None] >= ll[:, None])
@@ -85,6 +85,26 @@ def test_multibox_target_pad_rows_cannot_steal_anchor0():
     assert np.isfinite(loc_t.asnumpy()).all()
     # the matched anchor's offsets are ~0 (exact overlap), not degenerate
     np.testing.assert_allclose(loc_t.asnumpy()[0][:4], 0.0, atol=1e-5)
+
+
+def test_multibox_target_hard_negative_mining():
+    """negative_mining_ratio=1 with one positive: exactly one hard negative
+    (the one the classifier is most confident about) stays background 0,
+    other unmatched anchors become ignore_label -1."""
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.3, 0.3],
+                                  [0.4, 0.4, 0.6, 0.6],
+                                  [0.7, 0.7, 0.9, 0.9]]], np.float32))
+    label = nd.array(np.array([[[0, 0.0, 0.0, 0.3, 0.3]]], np.float32))
+    cls_pred = np.zeros((1, 3, 3), np.float32)
+    cls_pred[0, 1, 2] = 0.9         # anchor 2 = most object-confident
+    cls_pred[0, 1, 1] = 0.2
+    _, _, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, label, nd.array(cls_pred), negative_mining_ratio=1.0,
+        negative_mining_thresh=0.5)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 1.0             # the positive
+    assert ct[2] == 0.0             # hardest negative kept as background
+    assert ct[1] == -1.0            # remaining negative ignored
 
 
 def test_multibox_detection_emits_secondary_classes():
