@@ -26,7 +26,7 @@ Env knobs:
   MXNET_BENCH_BATCH       default 64
   MXNET_BENCH_SEQLEN      default 128
   MXNET_BENCH_DTYPE       bfloat16 (default) | float32
-  MXNET_BENCH_SCAN_STEPS  steps fused per dispatch, default 64
+  MXNET_BENCH_SCAN_STEPS  steps fused per dispatch, default 128
   MXNET_BENCH_DISPATCHES  timed dispatches, default 2
 """
 
@@ -213,7 +213,7 @@ def main():
     batch = int(os.environ.get("MXNET_BENCH_BATCH", "64"))
     seq_len = int(os.environ.get("MXNET_BENCH_SEQLEN", "128"))
     dtype = os.environ.get("MXNET_BENCH_DTYPE", "bfloat16")
-    scan_steps = int(os.environ.get("MXNET_BENCH_SCAN_STEPS", "64"))
+    scan_steps = int(os.environ.get("MXNET_BENCH_SCAN_STEPS", "128"))
     dispatches = int(os.environ.get("MXNET_BENCH_DISPATCHES", "2"))
 
     vision = not name.startswith("bert")

@@ -46,12 +46,32 @@ def run(num_layers=12, units=768, heads=12, batch=32, seq_len=128,
     from mxnet_tpu.parallel import DeviceMesh, TrainStep
 
     mx.random.seed(0)
-    model = bert.BERTModel(vocab_size=vocab, num_layers=num_layers,
-                           units=units, hidden_size=4 * units,
-                           num_heads=heads, max_length=seq_len)
+    core = bert.BERTModel(vocab_size=vocab, num_layers=num_layers,
+                          units=units, hidden_size=4 * units,
+                          num_heads=heads, max_length=seq_len)
+
+    class MaskedBERT(gluon.HybridBlock):
+        """Unpacks [tokens ++ valid_length] so the attention padding mask
+        actually drives the step (TrainStep feeds one data tensor)."""
+
+        def __init__(self, inner, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.inner = inner
+
+        def hybrid_forward(self, F, packed_in):
+            # token ids / lengths are indices — no gradient flows to them
+            toks = F.stop_gradient(
+                F.slice_axis(packed_in, axis=1, begin=0, end=seq_len))
+            vl = F.stop_gradient(F.reshape(
+                F.slice_axis(packed_in, axis=1, begin=seq_len,
+                             end=seq_len + 1), shape=(-1,))).astype("int32")
+            return self.inner(toks, vl)
+
+    model = MaskedBERT(core)
     model.initialize(mx.init.Normal(0.02))
     if tp > 1:
-        bert.apply_tp_shardings(model)
+        bert.apply_tp_shardings(core)
     import jax
     if dp * tp > 1:
         mesh = DeviceMesh(shape=(dp, tp), axis_names=("dp", "tp"))
@@ -76,7 +96,7 @@ def run(num_layers=12, units=768, heads=12, batch=32, seq_len=128,
     rng = np.random.RandomState(0)
     tokens, vl, labels, weights = synthetic_mlm_batch(rng, batch, seq_len,
                                                       vocab)
-    data = mx.nd.array(tokens)
+    data = mx.nd.array(np.concatenate([tokens, vl[:, None]], axis=1))
     packed = mx.nd.array(np.concatenate([labels, weights], axis=1))
 
     for _ in range(warmup):

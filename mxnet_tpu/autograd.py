@@ -290,6 +290,14 @@ def _run_tape_backward(tape, create_graph=False):
         for entry, g in zip(n.in_entries, in_grads):
             if entry is None or g is None:
                 continue
+            gd = g._data if hasattr(g, "_data") else g
+            if getattr(gd, "dtype", None) is not None:
+                import jax
+                if gd.dtype == jax.dtypes.float0:
+                    # gradient w.r.t. an integer-valued input (indices,
+                    # lengths): carries no information and float0 supports
+                    # no arithmetic — drop instead of accumulating
+                    continue
             kind = entry[0]
             if kind == "leaf":
                 entry[1]._accumulate_grad(g)

@@ -261,5 +261,5 @@ def test_contrib_namespace():
     assert np.allclose(out.asnumpy(), 1.0 / 4.0)
     with pytest.raises(AttributeError, match="StableHLO"):
         mx.contrib.onnx  # noqa: B018
-    with pytest.raises(AttributeError, match="deferred"):
-        mx.contrib.quantization  # noqa: B018
+    # INT8 quantization is rebuilt (N11/P19): the namespace must resolve
+    assert hasattr(mx.contrib.quantization, "quantize_net")
