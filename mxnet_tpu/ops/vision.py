@@ -615,3 +615,112 @@ def _deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
     if bias is not None and not no_bias:
         out = out + bias.reshape(1, -1, 1, 1)
     return out
+
+
+def _rpn_generate_anchors(ratios, scales, stride):
+    """Base anchors (A, 4) centered on one stride cell (reference
+    rcnn/generate_anchors logic used by proposal.cc)."""
+    import numpy as np
+    base = np.array([0, 0, stride - 1, stride - 1], np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + (w - 1) / 2
+    cy = base[1] + (h - 1) / 2
+    out = []
+    for r in ratios:
+        size = w * h
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            out.append([cx - (wss - 1) / 2, cy - (hss - 1) / 2,
+                        cx + (wss - 1) / 2, cy + (hss - 1) / 2])
+    return np.asarray(out, np.float32)
+
+
+@register("contrib.Proposal", differentiable=False, jit=False)
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+              feature_stride=16, output_score=False, iou_loss=False):
+    """Region-proposal op (reference contrib/proposal.cc / multi_proposal.cc,
+    the Faster-RCNN RPN): decode per-anchor box deltas on the feature grid,
+    clip to the image, drop boxes below rpn_min_size, keep the
+    pre-NMS top-K by objectness, greedy-NMS to ``threshold``, and emit
+    (N * post_nms_top_n, 5) rois [batch_idx, x1, y1, x2, y2] (+ scores
+    with output_score).  Host-side like box_nms (dynamic control flow)."""
+    import numpy as np
+    cls_prob = np.asarray(cls_prob)      # (N, 2A, H, W)
+    bbox_pred = np.asarray(bbox_pred)    # (N, 4A, H, W)
+    im_info = np.asarray(im_info)        # (N, 3): (height, width, scale)
+    N, _, H, W = cls_prob.shape
+    anchors = _rpn_generate_anchors(ratios, scales, feature_stride)  # (A,4)
+    A = anchors.shape[0]
+    shift_x = np.arange(W) * feature_stride
+    shift_y = np.arange(H) * feature_stride
+    sx, sy = np.meshgrid(shift_x, shift_y)
+    shifts = np.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()], 1)
+    all_anchors = (anchors[None] + shifts[:, None]).reshape(-1, 4)  # (HWA,4)
+
+    rois = np.zeros((N * rpn_post_nms_top_n, 5), np.float32)
+    scores_out = np.zeros((N * rpn_post_nms_top_n, 1), np.float32)
+    for n in range(N):
+        scores = cls_prob[n, A:].reshape(A, H * W).T.reshape(-1)  # fg probs
+        deltas = bbox_pred[n].reshape(A, 4, H * W) \
+            .transpose(2, 0, 1).reshape(-1, 4)
+        # decode (dx, dy, dw, dh) in anchor center-size space
+        ws = all_anchors[:, 2] - all_anchors[:, 0] + 1
+        hs = all_anchors[:, 3] - all_anchors[:, 1] + 1
+        cx = all_anchors[:, 0] + (ws - 1) / 2
+        cy = all_anchors[:, 1] + (hs - 1) / 2
+        pcx = deltas[:, 0] * ws + cx
+        pcy = deltas[:, 1] * hs + cy
+        pw = np.exp(np.clip(deltas[:, 2], -10, 10)) * ws
+        phh = np.exp(np.clip(deltas[:, 3], -10, 10)) * hs
+        boxes = np.stack([pcx - (pw - 1) / 2, pcy - (phh - 1) / 2,
+                          pcx + (pw - 1) / 2, pcy + (phh - 1) / 2], 1)
+        ih, iw, iscale = im_info[n]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - 1)
+        min_sz = rpn_min_size * iscale
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_sz)
+                & (boxes[:, 3] - boxes[:, 1] + 1 >= min_sz))
+        boxes, scores = boxes[keep], scores[keep]
+        order = np.argsort(-scores)[:rpn_pre_nms_top_n]
+        boxes, scores = boxes[order], scores[order]
+        # greedy NMS, vectorized suppression per kept box
+        areas = (boxes[:, 2] - boxes[:, 0] + 1) * \
+            (boxes[:, 3] - boxes[:, 1] + 1)
+        alive = np.ones(len(boxes), bool)
+        picked = []
+        for i in range(len(boxes)):
+            if not alive[i]:
+                continue
+            picked.append(i)
+            if len(picked) >= rpn_post_nms_top_n:
+                break
+            rest = slice(i + 1, None)
+            tl = np.maximum(boxes[i, :2], boxes[rest, :2])
+            br = np.minimum(boxes[i, 2:], boxes[rest, 2:])
+            wh = np.maximum(br - tl + 1, 0)
+            inter = wh[:, 0] * wh[:, 1]
+            iou = inter / np.maximum(areas[i] + areas[rest] - inter, 1e-12)
+            alive[rest] &= iou <= threshold
+        base = n * rpn_post_nms_top_n
+        for k, i in enumerate(picked):
+            rois[base + k] = [n, *boxes[i]]
+            scores_out[base + k, 0] = scores[i]
+        # reference pads short outputs by repeating the top roi
+        for k in range(len(picked), rpn_post_nms_top_n):
+            rois[base + k] = rois[base] if picked else [n, 0, 0, 15, 15]
+    if output_score:
+        return rois, scores_out
+    return rois
+
+
+@register("contrib.MultiProposal", differentiable=False, jit=False,
+          num_outputs=1)
+def _multi_proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    """Batch variant (reference multi_proposal.cc) — the host-side
+    implementation above already loops the batch."""
+    return _proposal(cls_prob, bbox_pred, im_info, **kwargs)

@@ -223,6 +223,33 @@ def test_deformable_conv_integer_shift():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_proposal_rpn():
+    """RPN proposals: right shape, batch indices, image clipping, and NMS
+    keeping the highest-objectness box first."""
+    r = np.random.RandomState(0)
+    N, A, H, W = 2, 9, 6, 6
+    kw = dict(scales=(8, 16, 32), ratios=(0.5, 1, 2))
+    cls = nd.array(r.rand(N, 2 * A, H, W).astype(np.float32))
+    bbox = nd.array((r.randn(N, 4 * A, H, W) * 0.1).astype(np.float32))
+    info = nd.array(np.array([[96, 96, 1.0]] * N, np.float32))
+    rois = nd.contrib.Proposal(cls, bbox, info, rpn_pre_nms_top_n=100,
+                               rpn_post_nms_top_n=20, rpn_min_size=4,
+                               **kw).asnumpy()
+    assert rois.shape == (N * 20, 5)
+    assert (rois[:20, 0] == 0).all() and (rois[20:, 0] == 1).all()
+    assert (rois[:, 1:] >= 0).all() and (rois[:, 1:] <= 95).all()
+    rois2, sc = nd.contrib.Proposal(cls, bbox, info, rpn_post_nms_top_n=10,
+                                    output_score=True, **kw)
+    sc = sc.asnumpy()
+    # first kept roi per image carries the max objectness of its image
+    fg = cls.asnumpy()[:, A:]
+    assert sc[0, 0] >= fg[0].max() - 1e-4 or sc[0, 0] > 0.99
+    # MultiProposal is the batch alias
+    mr = nd.contrib.MultiProposal(cls, bbox, info, rpn_post_nms_top_n=20,
+                                  **kw).asnumpy()
+    assert mr.shape == (N * 20, 5)
+
+
 def test_sync_batch_norm_and_contrib_layers():
     from mxnet_tpu.gluon.contrib import nn as cnn
     from mxnet_tpu.gluon import nn
