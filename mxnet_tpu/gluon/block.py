@@ -481,12 +481,101 @@ class HybridBlock(Block):
 
 
 class SymbolBlock(HybridBlock):
-    """Construct a block from a traced function + params (reference
-    SymbolBlock imports symbol json; here it wraps a traced callable)."""
+    """Run a symbolic graph (or traced callable) as a Gluon block.
 
-    def __init__(self, outputs_fn, params=None, prefix=None):
+    Two construction paths, mirroring the reference:
+     - ``SymbolBlock(callable)`` wraps a live traced function;
+     - ``SymbolBlock.imports(symbol_file, input_names, param_file)`` loads
+       the json+params interchange pair written by ``Symbol.save`` /
+       ``model.save_checkpoint`` (reference
+       gluon/block.py :: SymbolBlock.imports) and executes it through the
+       graph executor — the "train anywhere, serve elsewhere" round trip.
+    """
+
+    def __init__(self, outputs_fn=None, params=None, prefix=None):
         super().__init__(prefix=prefix, params=params)
         self._fn = outputs_fn
+        self._symbol = None
+        self._input_names = None
+        self._imported_params = {}
+        self._sb_executor = None
+        self._sb_shapes = None
+
+    @classmethod
+    def imports(cls, symbol_file, input_names, param_file=None, ctx=None):
+        """Load symbol json (+ optional .params) for inference.
+
+        ``param_file`` entries may be 'arg:NAME'/'aux:NAME'-prefixed
+        (Module/save_checkpoint convention) or flat names (Gluon
+        save_parameters convention)."""
+        from .. import symbol as _sym
+        from .. import ndarray as _ndm
+        sym = _sym.load(symbol_file) if isinstance(symbol_file, str) \
+            else symbol_file
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        blk = cls()
+        blk._symbol = sym
+        blk._input_names = list(input_names)
+        blk._sb_ctx = ctx
+        if param_file:
+            loaded = _ndm.load(param_file)
+            blk._imported_params = {
+                (k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                 else k): v
+                for k, v in loaded.items()}
+        return blk
+
+    def forward(self, *args, **kwargs):
+        if self._symbol is None:
+            return super().forward(*args, **kwargs)
+        from ..context import current_context
+        if kwargs:
+            raise MXNetError(
+                "SymbolBlock takes inputs positionally in input_names "
+                f"order {self._input_names} (got kwargs {list(kwargs)})")
+        if len(args) != len(self._input_names):
+            raise MXNetError(
+                f"SymbolBlock expects {len(self._input_names)} inputs "
+                f"{self._input_names}, got {len(args)}")
+        ctx = self._sb_ctx or current_context()
+        # inputs land on the bind ctx like the imported params do — feeding
+        # a cpu buffer into a tpu-bound executor is the classic device bug
+        ins = [(a if isinstance(a, NDArray) else nd.array(a))
+               .as_in_context(ctx) for a in args]
+        shapes = tuple(tuple(a.shape) for a in ins)
+        if self._sb_executor is None or self._sb_shapes != shapes:
+            shape_kw = dict(zip(self._input_names, shapes))
+            try:
+                ex = self._symbol.simple_bind(ctx, grad_req="null",
+                                              **shape_kw)
+            except MXNetError as e:
+                params = set(self._imported_params)
+                unbound = [a for a in self._symbol.list_arguments()
+                           if a not in params
+                           and a not in self._input_names]
+                raise MXNetError(
+                    f"SymbolBlock: could not bind — unbound inputs "
+                    f"{unbound} are neither in input_names nor in the "
+                    "param file. For a training checkpoint with a loss "
+                    "head (e.g. SoftmaxOutput's *_label), either list the "
+                    "label in input_names or strip the head first: "
+                    "sym.get_internals()['<name>_output'] "
+                    "(reference SymbolBlock.imports contract)") from e
+            for name in list(ex.arg_dict):
+                if name in self._imported_params:
+                    # .params files load on cpu; land them on the bind ctx
+                    ex.arg_dict[name] = \
+                        self._imported_params[name].as_in_context(ctx)
+            for name in list(ex.aux_dict):
+                if name in self._imported_params:
+                    ex.aux_dict[name] = \
+                        self._imported_params[name].as_in_context(ctx)
+            self._sb_executor, self._sb_shapes = ex, shapes
+        self._sb_executor.forward(
+            is_train=False, **dict(zip(self._input_names, ins)))
+        outs = self._sb_executor.outputs
+        return outs[0] if len(outs) == 1 else outs
 
     def hybrid_forward(self, F, *args, **params):  # noqa: ARG002
         return self._fn(*args, **params)

@@ -291,10 +291,14 @@ class Module(BaseModule):
         self.optimizer_initialized = True
 
     def _slice_for(self, arr, k):
-        """k-th even batch slice of arr, on the k-th context."""
+        """k-th even batch slice of arr, on the k-th context.  Data always
+        lands on the executor's context — iterators hand out host (cpu)
+        arrays, and a tpu-bound module must not feed cpu buffers into its
+        compiled graph (reference: DataParallelExecutorGroup copies slices
+        to each ctx)."""
         n = len(self._execs)
         if n == 1:
-            return arr
+            return arr.as_in_context(self._contexts[0])
         per = arr.shape[0] // n
         return arr[k * per:(k + 1) * per].as_in_context(self._contexts[k])
 

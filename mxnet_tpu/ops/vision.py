@@ -638,7 +638,8 @@ def _rpn_generate_anchors(ratios, scales, stride):
     return np.asarray(out, np.float32)
 
 
-@register("contrib.Proposal", differentiable=False, jit=False)
+@register("contrib.Proposal", differentiable=False, jit=False,
+          num_outputs=-1)
 def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
               rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
               scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
@@ -650,6 +651,12 @@ def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     (N * post_nms_top_n, 5) rois [batch_idx, x1, y1, x2, y2] (+ scores
     with output_score).  Host-side like box_nms (dynamic control flow)."""
     import numpy as np
+    if iou_loss:
+        from ..base import MXNetError
+        raise MXNetError("contrib.Proposal: iou_loss=True (direct corner "
+                         "offset decode) is not implemented on TPU — "
+                         "retrain/export the RPN head with the standard "
+                         "center-size delta parameterization")
     cls_prob = np.asarray(cls_prob)      # (N, 2A, H, W)
     bbox_pred = np.asarray(bbox_pred)    # (N, 4A, H, W)
     im_info = np.asarray(im_info)        # (N, 3): (height, width, scale)
@@ -710,16 +717,17 @@ def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
         for k, i in enumerate(picked):
             rois[base + k] = [n, *boxes[i]]
             scores_out[base + k, 0] = scores[i]
-        # reference pads short outputs by repeating the top roi
+        # reference pads short outputs by repeating the top roi+score pair
         for k in range(len(picked), rpn_post_nms_top_n):
             rois[base + k] = rois[base] if picked else [n, 0, 0, 15, 15]
+            scores_out[base + k, 0] = scores_out[base, 0] if picked else 0.0
     if output_score:
         return rois, scores_out
     return rois
 
 
 @register("contrib.MultiProposal", differentiable=False, jit=False,
-          num_outputs=1)
+          num_outputs=-1)
 def _multi_proposal(cls_prob, bbox_pred, im_info, **kwargs):
     """Batch variant (reference multi_proposal.cc) — the host-side
     implementation above already loops the batch."""

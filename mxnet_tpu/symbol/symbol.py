@@ -110,6 +110,16 @@ class Symbol:
         return 1 if self._out_index is not None else self._num_outputs
 
     def __getitem__(self, index):
+        if isinstance(index, str):
+            # reference convention: internals['fc2_output'] selects the
+            # node named 'fc2' (the '_output' suffix marks its output)
+            base = index[:-7] if index.endswith("_output") else index
+            pool = self._inputs if self._op == "group" else \
+                list(self._walk())
+            for s in pool:
+                if s._name in (base, index):
+                    return s
+            raise MXNetError(f"no internal symbol named {index!r}")
         if self._op == "group":
             return self._inputs[index]
         if isinstance(index, int):
@@ -117,7 +127,7 @@ class Symbol:
                 return self
             return Symbol("output_slice", [self], {"index": index},
                           name=f"{self._name}[{index}]")
-        raise MXNetError("symbol indexing requires an int")
+        raise MXNetError("symbol indexing requires an int or name")
 
     def get_internals(self):
         return Group(*[s for s in self._walk() if s._op is not None])

@@ -1,0 +1,80 @@
+"""SymbolBlock.imports — the json+params interchange round trip
+(reference gluon/block.py :: SymbolBlock.imports over Symbol.save +
+save_checkpoint artifacts; r2 verdict weak #8)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+
+
+def _train_and_save(tmp_path):
+    """Train a small symbolic net via Module, save_checkpoint, return
+    (prefix, reference predictions, input)."""
+    from mxnet_tpu.module import Module
+    x = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(x, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    out = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+    out = mx.sym.SoftmaxOutput(out, name="softmax")
+
+    mod = Module(out, data_names=["data"], label_names=["softmax_label"])
+    r = np.random.RandomState(0)
+    xs = r.randn(64, 8).astype(np.float32)
+    ys = r.randint(0, 3, (64,)).astype(np.float32)
+    it = mx.io.NDArrayIter(data=xs, label=ys, batch_size=16,
+                           label_name="softmax_label")
+    mod.fit(it, num_epoch=2,
+            optimizer_params={"learning_rate": 0.1})
+    prefix = os.path.join(str(tmp_path), "small")
+    mod.save_checkpoint(prefix, 2)
+    probe = xs[:8]
+    pred = mod.predict(mx.io.NDArrayIter(data=probe, batch_size=8))
+    if isinstance(pred, list):
+        pred = pred[0]
+    ref = pred.asnumpy()
+    return prefix, ref, probe
+
+
+def test_symbol_block_imports_checkpoint(tmp_path):
+    prefix, ref, probe = _train_and_save(tmp_path)
+    # checkpoint carries a SoftmaxOutput loss head: strip it down to the
+    # logits + an explicit softmax, the upstream inference-import pattern
+    loaded = mx.sym.load(f"{prefix}-symbol.json")
+    logits = loaded.get_internals()["fc2_output"]
+    infer_sym = mx.sym.softmax(logits)
+    blk = gluon.SymbolBlock.imports(infer_sym, ["data"],
+                                    f"{prefix}-0002.params")
+    out = blk(nd.array(probe)).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    # second call with a new batch size rebinds transparently
+    out2 = blk(nd.array(probe[:4])).asnumpy()
+    np.testing.assert_allclose(out2, ref[:4], rtol=1e-4, atol=1e-5)
+
+
+def test_symbol_block_unbound_label_raises_helpfully(tmp_path):
+    prefix, _, probe = _train_and_save(tmp_path)
+    blk = gluon.SymbolBlock.imports(f"{prefix}-symbol.json", ["data"],
+                                    f"{prefix}-0002.params")
+    with pytest.raises(mx.MXNetError, match="softmax_label"):
+        blk(nd.array(probe))
+
+
+def test_symbol_block_imports_without_params(tmp_path):
+    """Importing only the graph: params default to executor zeros."""
+    x = mx.sym.Variable("data")
+    y = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
+    path = os.path.join(str(tmp_path), "g-symbol.json")
+    y.save(path)
+    blk = gluon.SymbolBlock.imports(path, "data")
+    out = blk(nd.ones((3, 4)))
+    assert out.shape == (3, 2)
+
+
+def test_symbol_block_callable_path_still_works():
+    blk = gluon.SymbolBlock(lambda a: a * 2)
+    out = blk(nd.ones((2, 2)))
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
