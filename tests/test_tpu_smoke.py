@@ -129,3 +129,49 @@ def test_gluon_train_step_cpu_vs_tpu():
         losses[str(ctx)] = cur
     vals = list(losses.values())
     np.testing.assert_allclose(vals[0], vals[1], rtol=2e-2, atol=2e-3)
+
+
+def test_tpu_int8_quantized_fc_consistency():
+    """INT8 path produces identical quantized results cpu-vs-tpu (integer
+    arithmetic — results are exact, not approximate)."""
+    r = np.random.RandomState(12)
+    x = r.randn(32, 64).astype(np.float32)
+    w = (r.randn(16, 64) * 0.4).astype(np.float32)
+    outs = {}
+    for ctx in _ctxs():
+        nd = mx.nd
+        qx, xmin, xmax = nd.contrib.quantize_v2(nd.array(x, ctx=ctx))
+        qw, wmin, wmax = nd.contrib.quantize_v2(nd.array(w, ctx=ctx))
+        o32, omin, omax = nd.contrib.quantized_fully_connected(
+            qx, qw, xmin, xmax, wmin, wmax)
+        outs[str(ctx)] = nd.contrib.dequantize(o32, omin, omax).asnumpy()
+    vals = list(outs.values())
+    np.testing.assert_allclose(vals[0], vals[1], rtol=1e-5, atol=1e-6)
+
+
+def test_tpu_ctc_loss_consistency():
+    r = np.random.RandomState(13)
+    logits = r.randn(12, 2, 6).astype(np.float32)
+    label = np.array([[1, 2, 3, 0], [4, 2, 0, 0]], np.float32)
+    outs = {}
+    for ctx in _ctxs():
+        outs[str(ctx)] = mx.nd.ctc_loss(
+            mx.nd.array(logits, ctx=ctx),
+            mx.nd.array(label, ctx=ctx)).asnumpy()
+    vals = list(outs.values())
+    np.testing.assert_allclose(vals[0], vals[1], rtol=1e-4, atol=1e-5)
+
+
+def test_tpu_deformable_conv_consistency():
+    r = np.random.RandomState(14)
+    x = r.randn(1, 3, 8, 8).astype(np.float32)
+    w = r.randn(4, 3, 3, 3).astype(np.float32)
+    off = (r.randn(1, 18, 6, 6) * 0.5).astype(np.float32)
+    outs = {}
+    for ctx in _ctxs():
+        outs[str(ctx)] = mx.nd.contrib.DeformableConvolution(
+            mx.nd.array(x, ctx=ctx), mx.nd.array(off, ctx=ctx),
+            mx.nd.array(w, ctx=ctx), kernel=(3, 3),
+            num_filter=4).asnumpy()
+    vals = list(outs.values())
+    np.testing.assert_allclose(vals[0], vals[1], rtol=1e-3, atol=1e-4)
