@@ -119,8 +119,11 @@ def sequence_parallel_attention(q, k, v, mesh, axis="sp", seg_q=None,
     nesting shard_maps or pjit shardings outside).
     """
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from . import shard_map_compat
+    shard_map = shard_map_compat()
 
+    if hasattr(mesh, "mesh"):            # accept DeviceMesh too
+        mesh = mesh.mesh
     n = mesh.shape[axis] if isinstance(mesh.shape, dict) else dict(
         zip(mesh.axis_names, mesh.devices.shape))[axis]
     L = q.shape[2]
@@ -139,7 +142,6 @@ def sequence_parallel_attention(q, k, v, mesh, axis="sp", seg_q=None,
 
     in_specs = (spec_x, spec_x, spec_x) + ((spec_s, spec_s) if has_seg
                                            else ())
-    fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=spec_x,
-                   check_rep=False)
+    fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=spec_x)
     args = (q, k, v) + ((seg_q, seg_kv) if has_seg else ())
     return fn(*args)

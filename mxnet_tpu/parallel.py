@@ -37,12 +37,14 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["DeviceMesh", "make_mesh", "data_parallel_ctxs", "TrainStep",
            "allreduce", "allgather", "current_mesh", "set_mesh",
-           "attention", "ring_attention"]
+           "attention", "ring_attention", "ulysses_attention"]
 
 
 def __getattr__(name):
     # sequence-parallel attention (SURVEY §5.7): lazily re-exported so
-    # importing parallel doesn't pull the kernels package
+    # importing parallel doesn't pull the kernels package.  Two SP
+    # strategies: ring (K/V rotation, long-sequence memory win) and
+    # Ulysses (all-to-all head re-sharding, local attention).
     if name in ("attention", "ring_attention"):
         from .kernels.ring_attention import (ring_attention,
                                              sequence_parallel_attention)
@@ -50,6 +52,12 @@ def __getattr__(name):
             else ring_attention
         globals()[name] = val
         return val
+    if name == "ulysses_attention":
+        # the INSIDE-shard_map kernel, mirroring ring_attention's export;
+        # the global entry is kernels.ulysses.ulysses_sequence_parallel_attention
+        from .kernels.ulysses import ulysses_attention
+        globals()[name] = ulysses_attention
+        return ulysses_attention
     raise AttributeError(f"module 'mxnet_tpu.parallel' has no attribute {name!r}")
 
 

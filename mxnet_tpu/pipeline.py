@@ -38,15 +38,11 @@ __all__ = ["gpipe", "pipeline_apply", "stack_blocks", "PipelinedBlock"]
 
 
 def _shard_map():
-    """Returns (jax, shard_map) with the replication-check kwarg normalized
-    (new jax spells it check_vma, the experimental fallback check_rep)."""
+    """(jax, shard_map) with the replication check normalized — single
+    definition lives in kernels.shard_map_compat."""
     import jax
-    try:
-        from jax import shard_map as sm
-        return jax, functools.partial(sm, check_vma=False)
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as sm
-        return jax, functools.partial(sm, check_rep=False)
+    from .kernels import shard_map_compat
+    return jax, shard_map_compat()
 
 
 def gpipe(stage_fn, n_stages, n_microbatches, mesh, axis="pp",
