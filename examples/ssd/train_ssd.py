@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Tiny SSD trained on synthetic shapes (reference example/ssd/train.py
+workflow over the contrib multibox ops).
+
+The full reference loop in miniature: a small conv backbone emits a
+feature map; `MultiBoxPrior` lays anchors on it; `MultiBoxTarget` matches
+anchors to ground truth with hard negative mining; the net regresses
+class scores + box deltas against those targets (softmax CE with
+ignore_label -1 + smooth-L1); `MultiBoxDetection` decodes + NMS-filters
+predictions at eval time.
+
+Synthetic data: images containing one bright axis-aligned square (class
+0) or circle-ish blob (class 1); ground truth is its bounding box.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def synth_batch(rng, batch, size=32):
+    imgs = np.zeros((batch, 1, size, size), np.float32)
+    labels = np.zeros((batch, 1, 5), np.float32)
+    for i in range(batch):
+        cls = rng.randint(0, 2)
+        w = rng.randint(8, 16)
+        x0 = rng.randint(0, size - w)
+        y0 = rng.randint(0, size - w)
+        if cls == 0:
+            imgs[i, 0, y0:y0 + w, x0:x0 + w] = 1.0
+        else:
+            yy, xx = np.mgrid[0:size, 0:size]
+            m = ((yy - (y0 + w / 2)) ** 2 + (xx - (x0 + w / 2)) ** 2
+                 <= (w / 2) ** 2)
+            imgs[i, 0][m] = 1.0
+        labels[i, 0] = [cls, x0 / size, y0 / size,
+                        (x0 + w) / size, (y0 + w) / size]
+    return imgs, labels
+
+
+def run(batch=32, steps=60, lr=0.1, size=32, log=True, seed=0):
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    num_cls = 2
+    sizes, ratios = (0.4, 0.6), (1.0, 2.0)
+    A_per_pix = len(sizes) + len(ratios) - 1
+
+    class TinySSD(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.body = nn.HybridSequential()
+                for f in (16, 32):
+                    self.body.add(nn.Conv2D(f, 3, padding=1,
+                                            activation="relu"))
+                    self.body.add(nn.MaxPool2D(2))
+                self.cls_head = nn.Conv2D(A_per_pix * (num_cls + 1), 3,
+                                          padding=1)
+                self.box_head = nn.Conv2D(A_per_pix * 4, 3, padding=1)
+
+        def hybrid_forward(self, F, x):
+            feat = self.body(x)
+            anchors = F.contrib.MultiBoxPrior(feat, sizes=sizes,
+                                              ratios=ratios)
+            cp = self.cls_head(feat)       # (B, A*(C+1), h, w)
+            bp = self.box_head(feat)
+            B = x.shape[0]
+            cls_pred = F.transpose(cp, axes=(0, 2, 3, 1)) \
+                .reshape((B, -1, num_cls + 1))      # (B, A, C+1)
+            box_pred = F.transpose(bp, axes=(0, 2, 3, 1)) \
+                .reshape((B, -1))                   # (B, A*4)
+            return anchors, cls_pred, box_pred
+
+    mx.random.seed(seed)
+    net = TinySSD()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(seed)
+
+    losses = []
+    t0 = time.time()
+    for step in range(steps):
+        imgs, labels = synth_batch(rng, batch, size)
+        x = mx.nd.array(imgs)
+        y = mx.nd.array(labels)
+        with autograd.record():
+            anchors, cls_pred, box_pred = net(x)
+            with autograd.pause():
+                loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(
+                    anchors, y,
+                    mx.nd.transpose(cls_pred, axes=(0, 2, 1)),
+                    negative_mining_ratio=3.0,
+                    negative_mining_thresh=0.5)
+            # classification: CE over matched + mined anchors; ignored
+            # anchors (-1) get zero weight
+            flat_pred = cls_pred.reshape((-1, num_cls + 1))
+            flat_t = cls_t.reshape((-1,))
+            w = (flat_t >= 0).astype("float32")
+            cls_loss = (ce(flat_pred, mx.nd.maximum(
+                flat_t, mx.nd.zeros_like(flat_t))) * w).sum() \
+                / mx.nd.maximum(w.sum(), mx.nd.ones_like(w.sum()))
+            box_loss = (mx.nd.smooth_l1(
+                (box_pred - loc_t) * loc_m, scalar=1.0)).mean()
+            loss = cls_loss + box_loss
+        loss.backward()
+        trainer.step(batch)
+        losses.append(float(loss.asnumpy()))
+
+    # eval: decode + NMS on a fresh batch, report mean IoU of top detection
+    imgs, labels = synth_batch(rng, 16, size)
+    anchors, cls_pred, box_pred = net(mx.nd.array(imgs))
+    probs = mx.nd.softmax(cls_pred, axis=-1)
+    det = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.transpose(probs, axes=(0, 2, 1)), box_pred, anchors,
+        nms_threshold=0.45, threshold=0.05).asnumpy()
+    ious = []
+    for i in range(16):
+        top = det[i, 0]
+        if top[0] < 0:
+            ious.append(0.0)
+            continue
+        gt = labels[i, 0, 1:]
+        tl = np.maximum(top[2:4], gt[:2])
+        br = np.minimum(top[4:6], gt[2:])
+        inter = np.prod(np.maximum(br - tl, 0))
+        union = (np.prod(top[4:6] - top[2:4])
+                 + np.prod(gt[2:] - gt[:2]) - inter)
+        ious.append(float(inter / max(union, 1e-12)))
+    rec = {"first_loss": round(losses[0], 4),
+           "last_loss": round(losses[-1], 4),
+           "mean_top_iou": round(float(np.mean(ious)), 4),
+           "steps_per_sec": round(steps / (time.time() - t0), 2)}
+    if log:
+        print(json.dumps(rec))
+    return rec
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--batch", type=int, default=32)
+    a = p.parse_args()
+    run(batch=a.batch, steps=a.steps)
+
+
+if __name__ == "__main__":
+    main()

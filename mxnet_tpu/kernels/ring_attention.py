@@ -133,7 +133,15 @@ def sequence_parallel_attention(q, k, v, mesh, axis="sp", seg_q=None,
 
     spec_x = P(None, None, axis, None)
     spec_s = P(None, axis)
-    has_seg = seg_q is not None
+    has_seg = seg_q is not None or seg_kv is not None
+    if has_seg:
+        # one-sided segment masks are legal: the absent side defaults to
+        # the kernel's all-zeros segment (matches kv/q ids of 0) — gating
+        # on seg_q alone silently dropped a seg_kv-only padding mask
+        if seg_q is None:
+            seg_q = jnp.zeros((q.shape[0], q.shape[2]), jnp.int32)
+        if seg_kv is None:
+            seg_kv = jnp.zeros((q.shape[0], q.shape[2]), jnp.int32)
 
     def local(qb, kb, vb, *segs):
         sq, skv = (segs if has_seg else (None, None))

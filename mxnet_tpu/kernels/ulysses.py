@@ -94,8 +94,11 @@ def ulysses_sequence_parallel_attention(q, k, v, mesh, axis="sp",
             "ulysses: segment masking not implemented — use the ring "
             "strategy (sequence_parallel_attention) for segmented batches")
     raw_mesh = mesh.mesh if hasattr(mesh, "mesh") else mesh
-    key = (id(raw_mesh), axis, causal, float(sm_scale),
-           tuple(q.shape), str(q.dtype))
+    # key by device ids + axes (the _collective_cache convention), not
+    # object identity: rebuilding a DeviceMesh per phase must hit the
+    # cache, and jax.jit already keys shapes itself
+    key = (tuple(d.id for d in raw_mesh.devices.flat),
+           tuple(raw_mesh.axis_names), axis, causal, float(sm_scale))
     f = _jit_cache.get(key)
     if f is None:
         P = jax.sharding.PartitionSpec

@@ -178,7 +178,8 @@ class KVStoreDistTPUSync(KVStoreLocal):
         if fn is None:
             import jax
             from jax.sharding import PartitionSpec as P
-            from jax.experimental.shard_map import shard_map
+            from ..kernels import shard_map_compat
+            shard_map = shard_map_compat()
             mesh = self._proc_mesh()
 
             def reduce_(x):  # x block: (1, *shape) per device
@@ -230,14 +231,15 @@ class KVStoreDistTPUSync(KVStoreLocal):
         if fn is None:
             import jax
             from jax.sharding import PartitionSpec as P
-            from jax.experimental.shard_map import shard_map
+            from ..kernels import shard_map_compat
+            shard_map = shard_map_compat()
             mesh = self._proc_mesh()
 
             def gather(x):  # block (1, *shape) → (P, *shape) replicated
                 return jax.lax.all_gather(x[0], "proc")
 
             fn = jax.jit(shard_map(gather, mesh=mesh, in_specs=P("proc"),
-                                   out_specs=P(), check_rep=False))
+                                   out_specs=P()))
             self._psum_cache[key] = fn
         return fn
 

@@ -176,10 +176,8 @@ def _collective_fn(kind, mesh, shape, dtype, variant):
     if fn is not None:
         return fn
     jax = _jax()
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from .kernels import shard_map_compat
+    shard_map = shard_map_compat()
     axis = mesh.axis_names[0]
     n = mesh.size
     if kind == "allreduce":

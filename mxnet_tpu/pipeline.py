@@ -28,8 +28,6 @@ microbatch t-(S-1).  Bubble fraction = (S-1)/(M+S-1), the GPipe bound.
 
 from __future__ import annotations
 
-import functools
-
 import numpy as _np
 
 from .base import MXNetError

@@ -51,6 +51,13 @@ def test_lstm_lm_perplexity_drops():
     assert hist[-1]["perplexity"] < hist[0]["perplexity"]
 
 
+def test_ssd_trains_and_detects():
+    mod = _load("ssd/train_ssd.py")
+    rec = mod.run(batch=16, steps=40, log=False)
+    assert rec["last_loss"] < rec["first_loss"]
+    assert rec["mean_top_iou"] > 0.05     # detections overlap ground truth
+
+
 def test_matrix_factorization_model_parallel():
     mod = _load("model_parallel/matrix_factorization.py")
     rec = mod.run(num_users=64, num_items=64, factor=16, batch=64,

@@ -69,6 +69,25 @@ def test_ring_segment_mask(seeded):
                                atol=2e-5)
 
 
+def test_ring_seg_kv_only_is_honored(seeded):
+    """A kv-side-only padding mask must not be silently dropped: padded
+    keys (seg id != 0) are excluded from every query's context."""
+    B, H, L, D, n = 1, 2, 16, 4, 4
+    r = np.random.RandomState(2)
+    q = r.randn(B, H, L, D).astype(np.float32)
+    k = r.randn(B, H, L, D).astype(np.float32)
+    v = r.randn(B, H, L, D).astype(np.float32)
+    seg_kv = np.zeros((B, L), np.int32)
+    seg_kv[0, 12:] = 1                    # last 4 keys are padding
+    mesh = _mesh(n)
+    out = sequence_parallel_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh, axis="sp",
+        seg_kv=jnp.asarray(seg_kv), sm_scale=1.0)
+    ref = _dense_ref(q, k, v, seg_q=np.zeros((B, L), np.int32),
+                     seg_kv=seg_kv)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
 def test_ring_gradients_match_dense(seeded):
     B, H, L, D, n = 1, 2, 16, 4, 4
     r = np.random.RandomState(2)
