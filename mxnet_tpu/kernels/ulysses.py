@@ -98,7 +98,8 @@ def ulysses_sequence_parallel_attention(q, k, v, mesh, axis="sp",
     # object identity: rebuilding a DeviceMesh per phase must hit the
     # cache, and jax.jit already keys shapes itself
     key = (tuple(d.id for d in raw_mesh.devices.flat),
-           tuple(raw_mesh.axis_names), axis, causal, float(sm_scale))
+           tuple(raw_mesh.axis_names), tuple(raw_mesh.devices.shape),
+           axis, causal, float(sm_scale))
     f = _jit_cache.get(key)
     if f is None:
         P = jax.sharding.PartitionSpec

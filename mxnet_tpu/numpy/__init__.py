@@ -121,6 +121,7 @@ def _wrap_jnp(name, jfn):
 _NP_FUNCS = [
     # creation / manipulation
     "zeros", "ones", "full", "empty", "arange", "linspace", "logspace",
+    "zeros_like", "ones_like", "full_like", "empty_like", "copy",
     "eye", "identity", "meshgrid", "tri", "tril", "triu", "diag", "diagonal",
     "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
     "expand_dims", "squeeze", "broadcast_to", "broadcast_arrays",
@@ -168,6 +169,16 @@ _NP_FUNCS = [
 ]
 
 _self = _sys.modules[__name__]
+
+
+def asarray(object, dtype=None, ctx=None):
+    """Alias of :func:`array` — must share its dtype-inference rule
+    (python floats → float32), not jnp.asarray's float64 under x64."""
+    return array(object, dtype=dtype, ctx=ctx)
+
+
+# jax arrays are immutable, so contiguity is moot — same alias
+ascontiguousarray = asarray
 
 
 def _populate():

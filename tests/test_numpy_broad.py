@@ -1,0 +1,232 @@
+"""Broad table-driven mx.np ↔ numpy parity sweep (reference
+test_numpy_op.py's per-op coverage style, P3/N7 numpy families).
+
+Each case runs the mx.np function and the same-named numpy function on
+identical inputs and asserts elementwise agreement — ~90 functions across
+unary/binary/reduction/shape/linalg families, plus np.random statistical
+checks and npx.set_np semantics."""
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+np = mx.np
+
+
+def _r(shape, seed=0, positive=False, small=False):
+    r = onp.random.RandomState(seed)
+    x = r.randn(*shape).astype(onp.float32)
+    if positive:
+        x = onp.abs(x) + 0.1
+    if small:
+        x = x * 0.4
+    return x
+
+
+UNARY = [
+    ("exp", {}), ("expm1", {}), ("log", {"positive": True}),
+    ("log2", {"positive": True}), ("log10", {"positive": True}),
+    ("log1p", {"positive": True}), ("sqrt", {"positive": True}),
+    ("cbrt", {}), ("square", {}), ("abs", {}), ("sign", {}),
+    ("floor", {}), ("ceil", {}), ("trunc", {}), ("rint", {}),
+    ("sin", {}), ("cos", {}), ("tan", {"small": True}),
+    ("arcsin", {"small": True}), ("arccos", {"small": True}),
+    ("arctan", {}), ("sinh", {}), ("cosh", {}), ("tanh", {}),
+    ("arcsinh", {}), ("arctanh", {"small": True}),
+    ("degrees", {}), ("radians", {}), ("reciprocal", {"positive": True}),
+    ("negative", {}), ("exp2", {"small": True}),
+]
+
+
+@pytest.mark.parametrize("name,opts", UNARY, ids=[u[0] for u in UNARY])
+def test_np_unary(name, opts):
+    if not hasattr(np, name) or not hasattr(onp, name):
+        pytest.skip(f"{name} not on both surfaces")
+    x = _r((3, 5), positive=opts.get("positive", False),
+           small=opts.get("small", False))
+    got = getattr(np, name)(np.array(x)).asnumpy()
+    want = getattr(onp, name)(x)
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+BINARY = ["add", "subtract", "multiply", "divide", "power", "maximum",
+          "minimum", "hypot", "arctan2", "fmod", "copysign",
+          "greater", "greater_equal", "less", "less_equal", "equal",
+          "not_equal", "logaddexp"]
+
+
+@pytest.mark.parametrize("name", BINARY)
+def test_np_binary(name):
+    if not hasattr(np, name) or not hasattr(onp, name):
+        pytest.skip(f"{name} not on both surfaces")
+    a = onp.abs(_r((4, 3), 1)) + 0.5
+    b = onp.abs(_r((4, 3), 2)) + 0.5
+    got = getattr(np, name)(np.array(a), np.array(b)).asnumpy()
+    want = getattr(onp, name)(a, b)
+    onp.testing.assert_allclose(onp.asarray(got, want.dtype), want,
+                                rtol=2e-5, atol=2e-6)
+
+
+REDUCTIONS = ["sum", "prod", "mean", "std", "var", "max", "min",
+              "argmax", "argmin", "cumsum", "cumprod"]
+
+
+@pytest.mark.parametrize("name", REDUCTIONS)
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_np_reductions(name, axis):
+    x = onp.abs(_r((3, 4), 3)) * 0.5 + 0.5
+    got = getattr(np, name)(np.array(x), axis=axis).asnumpy()
+    want = getattr(onp, name)(x, axis=axis)
+    onp.testing.assert_allclose(onp.asarray(got, dtype=want.dtype), want,
+                                rtol=2e-5, atol=1e-5)
+
+
+SHAPE_FNS = [
+    ("reshape", lambda m, x: m.reshape(m.array(x), (6, 2)),
+     lambda x: onp.reshape(x, (6, 2))),
+    ("transpose", lambda m, x: m.transpose(m.array(x)),
+     lambda x: onp.transpose(x)),
+    ("concatenate", lambda m, x: m.concatenate([m.array(x), m.array(x)],
+                                               axis=0),
+     lambda x: onp.concatenate([x, x], axis=0)),
+    ("stack", lambda m, x: m.stack([m.array(x), m.array(x)], axis=1),
+     lambda x: onp.stack([x, x], axis=1)),
+    ("split", lambda m, x: m.split(m.array(x), 2, axis=0)[1],
+     lambda x: onp.split(x, 2, axis=0)[1]),
+    ("flip", lambda m, x: m.flip(m.array(x), axis=1),
+     lambda x: onp.flip(x, axis=1)),
+    ("roll", lambda m, x: m.roll(m.array(x), 2, axis=0),
+     lambda x: onp.roll(x, 2, axis=0)),
+    ("tile", lambda m, x: m.tile(m.array(x), (2, 1)),
+     lambda x: onp.tile(x, (2, 1))),
+    ("repeat", lambda m, x: m.repeat(m.array(x), 2, axis=1),
+     lambda x: onp.repeat(x, 2, axis=1)),
+    ("expand_dims", lambda m, x: m.expand_dims(m.array(x), 0),
+     lambda x: onp.expand_dims(x, 0)),
+    ("squeeze", lambda m, x: m.squeeze(m.expand_dims(m.array(x), 0)),
+     lambda x: x),
+    ("where", lambda m, x: m.where(m.array(x) > 0, m.array(x),
+                                   m.zeros_like(m.array(x))),
+     lambda x: onp.where(x > 0, x, onp.zeros_like(x))),
+    ("clip", lambda m, x: m.clip(m.array(x), -0.5, 0.5),
+     lambda x: onp.clip(x, -0.5, 0.5)),
+    ("sort", lambda m, x: m.sort(m.array(x), axis=1),
+     lambda x: onp.sort(x, axis=1)),
+    ("argsort", lambda m, x: m.argsort(m.array(x), axis=1),
+     lambda x: onp.argsort(x, axis=1)),
+    ("unique", lambda m, x: m.unique(m.array(onp.round(x))),
+     lambda x: onp.unique(onp.round(x))),
+    ("diff", lambda m, x: m.diff(m.array(x), axis=1),
+     lambda x: onp.diff(x, axis=1)),
+    ("pad", lambda m, x: m.pad(m.array(x), ((1, 1), (0, 0))),
+     lambda x: onp.pad(x, ((1, 1), (0, 0)))),
+    ("trace", lambda m, x: m.trace(m.array(x)),
+     lambda x: onp.trace(x)),
+    ("outer", lambda m, x: m.outer(m.array(x[0]), m.array(x[1])),
+     lambda x: onp.outer(x[0], x[1])),
+    ("einsum", lambda m, x: m.einsum("ij,kj->ik", m.array(x), m.array(x)),
+     lambda x: onp.einsum("ij,kj->ik", x, x)),
+    ("dot", lambda m, x: m.dot(m.array(x), m.array(x.T)),
+     lambda x: onp.dot(x, x.T)),
+    ("matmul", lambda m, x: m.matmul(m.array(x), m.array(x.T)),
+     lambda x: onp.matmul(x, x.T)),
+    ("tensordot", lambda m, x: m.tensordot(m.array(x), m.array(x),
+                                           axes=([1], [1])),
+     lambda x: onp.tensordot(x, x, axes=([1], [1]))),
+    ("kron", lambda m, x: m.kron(m.array(x[:2, :2]), m.array(x[:2, :2])),
+     lambda x: onp.kron(x[:2, :2], x[:2, :2])),
+    ("meshgrid", lambda m, x: m.meshgrid(m.array(x[0]), m.array(x[1]))[0],
+     lambda x: onp.meshgrid(x[0], x[1])[0]),
+    ("atleast_2d", lambda m, x: m.atleast_2d(m.array(x[0])),
+     lambda x: onp.atleast_2d(x[0])),
+    ("ravel", lambda m, x: m.ravel(m.array(x)),
+     lambda x: onp.ravel(x)),
+    ("triu", lambda m, x: m.triu(m.array(x)), lambda x: onp.triu(x)),
+    ("tril", lambda m, x: m.tril(m.array(x)), lambda x: onp.tril(x)),
+]
+
+
+@pytest.mark.parametrize("case", SHAPE_FNS, ids=[c[0] for c in SHAPE_FNS])
+def test_np_shape_and_linalgish(case):
+    name, mx_fn, onp_fn = case
+    if not hasattr(np, name):
+        pytest.skip(f"mx.np.{name} absent")
+    x = _r((4, 3), 7)
+    got = mx_fn(np, x)
+    got = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    want = onp_fn(x)
+    onp.testing.assert_allclose(onp.asarray(got, dtype=want.dtype), want,
+                                rtol=2e-5, atol=2e-6)
+
+
+LINALG = [
+    ("norm", lambda m, a: m.linalg.norm(a), lambda a: onp.linalg.norm(a)),
+    ("det", lambda m, a: m.linalg.det(a), lambda a: onp.linalg.det(a)),
+    ("inv", lambda m, a: m.linalg.inv(a), lambda a: onp.linalg.inv(a)),
+    ("slogdet", lambda m, a: m.linalg.slogdet(a)[1],
+     lambda a: onp.linalg.slogdet(a)[1]),
+    ("solve", lambda m, a: m.linalg.solve(a, m.ones((3, 1))
+                                          if hasattr(m, 'ones') else None),
+     lambda a: onp.linalg.solve(a, onp.ones((3, 1), onp.float32))),
+    ("cholesky", lambda m, a: m.linalg.cholesky(a),
+     lambda a: onp.linalg.cholesky(a)),
+    ("eigvalsh", lambda m, a: m.linalg.eigvalsh(a),
+     lambda a: onp.linalg.eigvalsh(a)),
+    ("matrix_rank", lambda m, a: m.linalg.matrix_rank(a),
+     lambda a: onp.linalg.matrix_rank(a)),
+    ("pinv", lambda m, a: m.linalg.pinv(a), lambda a: onp.linalg.pinv(a)),
+]
+
+
+@pytest.mark.parametrize("case", LINALG, ids=[c[0] for c in LINALG])
+def test_np_linalg(case):
+    name, mx_fn, onp_fn = case
+    if not hasattr(np.linalg, name):
+        pytest.skip(f"mx.np.linalg.{name} absent")
+    r = onp.random.RandomState(11)
+    a = r.randn(3, 3).astype(onp.float32)
+    spd = (a @ a.T + 3 * onp.eye(3)).astype(onp.float32)  # SPD for chol etc.
+    got = mx_fn(np, np.array(spd))
+    got = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    want = onp_fn(spd)
+    onp.testing.assert_allclose(got, onp.asarray(want), rtol=5e-4,
+                                atol=5e-5)
+
+
+def test_np_random_statistics():
+    mx.random.seed(7)
+    u = np.random.uniform(0, 1, size=(20000,)).asnumpy()
+    assert 0.48 < u.mean() < 0.52
+    assert u.min() >= 0 and u.max() <= 1
+    g = np.random.normal(2.0, 3.0, size=(20000,)).asnumpy()
+    assert abs(g.mean() - 2.0) < 0.1
+    assert abs(g.std() - 3.0) < 0.1
+    ri = np.random.randint(0, 10, size=(5000,)).asnumpy()
+    assert set(onp.unique(ri)) <= set(range(10))
+
+
+def test_np_autograd_through_np_functions():
+    """mx.np functions record on the imperative tape like nd ops."""
+    x = np.array(_r((3, 3), 13))
+    x.attach_grad()
+    with autograd.record():
+        y = np.sum(np.tanh(x) * np.exp(x * 0.1))
+    y.backward()
+    g = x.grad.asnumpy()
+    xv = x.asnumpy()
+    want = (1 - onp.tanh(xv) ** 2) * onp.exp(xv * 0.1) \
+        + onp.tanh(xv) * 0.1 * onp.exp(xv * 0.1)
+    onp.testing.assert_allclose(g, want, rtol=1e-4, atol=1e-5)
+
+
+def test_npx_set_np_toggles():
+    mx.npx.set_np()
+    try:
+        from mxnet_tpu.util import is_np_array
+        assert is_np_array()
+    finally:
+        mx.npx.reset_np()
+    from mxnet_tpu.util import is_np_array
+    assert not is_np_array()
