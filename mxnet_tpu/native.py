@@ -1,0 +1,158 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference keeps its IO hot paths in C++ (dmlc-core recordio,
+src/io/iter_image_recordio_2.cc); this module is the TPU rebuild's native
+seam: a small C ABI (mxnet_tpu/src/*.cc) compiled on demand with g++ and
+loaded with ctypes — no pybind11 dependency, and the C boundary stays as
+language-portable as the reference's C API.
+
+Build-on-first-use: the shared library lands next to the sources
+(mxnet_tpu/src/librecordio.so) or, if the package dir is read-only, under
+``$MXNET_NATIVE_CACHE`` (default ~/.cache/mxnet_tpu).  Every entry point
+has a pure-python fallback — the native path is a fast lane, never a
+requirement (``MXNET_USE_NATIVE=0`` disables it outright).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as _np
+
+__all__ = ["recordio_lib", "native_available", "index_recordio",
+           "read_recordio_batch"]
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src",
+                    "recordio.cc")
+
+_ERRORS = {
+    -1: "cannot open file",
+    -2: "bad record framing (magic/length mismatch)",
+    -3: "split (multi-chunk) records not supported by the native scanner",
+    -4: "I/O error",
+    -5: "output buffer too small",
+}
+
+
+def _so_candidates():
+    yield os.path.join(os.path.dirname(_SRC), "librecordio.so")
+    cache = os.environ.get(
+        "MXNET_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu"))
+    yield os.path.join(cache, "librecordio.so")
+
+
+def _compile(out_path):
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", out_path,
+           _SRC]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+
+
+def _bind(path):
+    lib = ctypes.CDLL(path)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.rio_index.argtypes = [ctypes.c_char_p, ctypes.POINTER(u64p),
+                              ctypes.POINTER(u64p),
+                              ctypes.POINTER(ctypes.c_uint64)]
+    lib.rio_index.restype = ctypes.c_int
+    lib.rio_read_batch.argtypes = [
+        ctypes.c_char_p, u64p, u64p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.rio_read_batch.restype = ctypes.c_int
+    lib.rio_free.argtypes = [ctypes.c_void_p]
+    lib.rio_free.restype = None
+    return lib
+
+
+def recordio_lib():
+    """The bound native library, building it on first use; None when the
+    toolchain/lib is unavailable or MXNET_USE_NATIVE=0."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MXNET_USE_NATIVE", "1") == "0":
+            return None
+        for cand in _so_candidates():
+            try:
+                if not os.path.exists(cand):
+                    _compile(cand)
+                _lib = _bind(cand)
+                return _lib
+            except Exception:  # noqa: BLE001 — any failure → next candidate
+                continue
+        return None
+
+
+def native_available():
+    return recordio_lib() is not None
+
+
+def _check(rc, what):
+    if rc != 0:
+        from .base import MXNetError
+        raise MXNetError(
+            f"native recordio {what}: {_ERRORS.get(rc, f'error {rc}')}")
+
+
+def index_recordio(path):
+    """Scan a .rec file natively → (offsets, lengths) uint64 ndarrays of
+    payload positions.  Raises on malformed files; returns None when the
+    native lib is unavailable (caller falls back to python scanning)."""
+    lib = recordio_lib()
+    if lib is None:
+        return None
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    offs, lens = u64p(), u64p()
+    count = ctypes.c_uint64()
+    rc = lib.rio_index(path.encode(), ctypes.byref(offs),
+                       ctypes.byref(lens), ctypes.byref(count))
+    _check(rc, "index")
+    n = count.value
+    try:
+        o = _np.ctypeslib.as_array(offs, shape=(n,)).copy() if n else \
+            _np.empty((0,), _np.uint64)
+        l = _np.ctypeslib.as_array(lens, shape=(n,)).copy() if n else \
+            _np.empty((0,), _np.uint64)
+    finally:
+        if n:
+            lib.rio_free(offs)
+            lib.rio_free(lens)
+    return o, l
+
+
+def read_recordio_batch(path, offsets, lengths):
+    """Bulk-read payloads at (offsets, lengths) → list of bytes.  Returns
+    None when the native lib is unavailable."""
+    lib = recordio_lib()
+    if lib is None:
+        return None
+    offsets = _np.ascontiguousarray(offsets, _np.uint64)
+    lengths = _np.ascontiguousarray(lengths, _np.uint64)
+    total = int(lengths.sum())
+    out = _np.empty((total,), _np.uint8)
+    written = ctypes.c_uint64()
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    rc = lib.rio_read_batch(
+        path.encode(), offsets.ctypes.data_as(u64p),
+        lengths.ctypes.data_as(u64p), len(offsets),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), total,
+        ctypes.byref(written))
+    _check(rc, "read_batch")
+    res, pos = [], 0
+    for ln in lengths:
+        res.append(out[pos:pos + int(ln)].tobytes())
+        pos += int(ln)
+    return res

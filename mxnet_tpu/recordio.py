@@ -108,7 +108,10 @@ class MXRecordIO:
         self.close()
 
     def __del__(self):
-        self.close()
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown: builtins
+            pass           # (open) may already be gone; nothing to save
 
 
 class MXIndexedRecordIO(MXRecordIO):
@@ -125,6 +128,7 @@ class MXIndexedRecordIO(MXRecordIO):
         super().open()
         self.idx = {}
         self.keys = []
+        self._scan_cache = None     # native framing scan, built lazily
         if not self.writable and os.path.isfile(self.idx_path):
             with open(self.idx_path) as fin:
                 for line in fin:
@@ -155,6 +159,48 @@ class MXIndexedRecordIO(MXRecordIO):
         self.write(buf)
         self.idx[key] = pos
         self.keys.append(key)
+
+    def _native_scan(self):
+        """Framing scan via the native lib, cached per open() (one C pass,
+        reused by every read_batch); None when unavailable/unreadable."""
+        from . import native
+        if self._scan_cache is None:
+            try:
+                scan = native.index_recordio(self.uri)
+            except MXNetError:
+                scan = None       # malformed tail/split records → fallback
+            if scan is None:
+                self._scan_cache = False
+            else:
+                offs, lens = scan
+                self._scan_cache = {
+                    int(o) - 8: (int(o), int(ln))
+                    for o, ln in zip(offs.tolist(), lens.tolist())}
+        return self._scan_cache or None
+
+    def read_batch(self, indices):
+        """Bulk-read many records by key in one native pass (the reference
+        keeps this scan in C++ — dmlc recordio + iter_image_recordio_2.cc);
+        falls back to per-record python reads without the native lib."""
+        from . import native
+        if self.writable:
+            # the python path raises here too; the native lane must not
+            # silently read a half-flushed file
+            raise MXNetError("read_batch: file opened for writing")
+        positions = [self.idx[self.key_type(i)] for i in indices]
+        by_pos = self._native_scan() if native.native_available() else None
+        if by_pos is not None:
+            try:
+                sel = [by_pos[int(p)] for p in positions]
+                res = native.read_recordio_batch(
+                    self.uri,
+                    _np.asarray([s[0] for s in sel], _np.uint64),
+                    _np.asarray([s[1] for s in sel], _np.uint64))
+                if res is not None:
+                    return res
+            except (KeyError, MXNetError):
+                pass              # sidecar/framing disagreement → fallback
+        return [self.read_idx(self.key_type(i)) for i in indices]
 
 
 def pack(header, s):
