@@ -433,10 +433,9 @@ class ImageRecordIter(DataIter):
         self._cursor += self.batch_size
         return self._cursor + self.batch_size <= len(self._keys)
 
-    def _decode_one(self, key):
+    def _decode_one(self, raw):
         import cv2
         from .. import recordio as rio
-        raw = self._rec.read_idx(self._keys[key])
         header, img_bytes = rio.unpack(raw)
         img = cv2.imdecode(_np.frombuffer(img_bytes, _np.uint8),
                            cv2.IMREAD_COLOR)
@@ -470,12 +469,16 @@ class ImageRecordIter(DataIter):
         if not self.iter_next():
             raise StopIteration
         idxs = self._order[self._cursor:self._cursor + self.batch_size]
+        # fetch ALL raw records in one pass (native bulk read when built)
+        # BEFORE fanning out: per-thread read_idx would race seek/read on
+        # the shared file handle, and the C scan beats per-record seeks
+        raws = self._rec.read_batch([self._keys[i] for i in idxs])
         from concurrent.futures import ThreadPoolExecutor
         if self._threads > 1:
             with ThreadPoolExecutor(self._threads) as ex:
-                results = list(ex.map(self._decode_one, idxs))
+                results = list(ex.map(self._decode_one, raws))
         else:
-            results = [self._decode_one(i) for i in idxs]
+            results = [self._decode_one(r) for r in raws]
         imgs = _np.stack([r[0] for r in results])
         labels = _np.asarray([r[1] for r in results], _np.float32)
         return DataBatch([nd.array(imgs)], [nd.array(labels)], pad=0)

@@ -38,6 +38,7 @@ _ERRORS = {
     -3: "split (multi-chunk) records not supported by the native scanner",
     -4: "I/O error",
     -5: "output buffer too small",
+    -6: "out of memory",
 }
 
 
@@ -51,9 +52,22 @@ def _so_candidates():
 
 def _compile(out_path):
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", out_path,
-           _SRC]
+    # compile to a unique temp name, then atomically rename: concurrent
+    # workers (tools/launch.py spawns N processes) must never CDLL a
+    # half-written ELF
+    tmp = f"{out_path}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
     subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    os.replace(tmp, out_path)
+
+
+def _fresh(so_path):
+    """A prebuilt .so is reusable only if at least as new as the source —
+    a stale binary would silently keep old scanner behavior after a fix."""
+    try:
+        return os.path.getmtime(so_path) >= os.path.getmtime(_SRC)
+    except OSError:
+        return False
 
 
 def _bind(path):
@@ -87,7 +101,7 @@ def recordio_lib():
             return None
         for cand in _so_candidates():
             try:
-                if not os.path.exists(cand):
+                if not (os.path.exists(cand) and _fresh(cand)):
                     _compile(cand)
                 _lib = _bind(cand)
                 return _lib

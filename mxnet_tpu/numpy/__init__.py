@@ -183,6 +183,9 @@ ascontiguousarray = asarray
 
 def _populate():
     import jax.numpy as jnp
+    # np.fix (truncate toward zero) — jnp.fix is deprecated for jnp.trunc;
+    # bind trunc up front so the table loop never touches the warning attr
+    setattr(_self, "fix", _wrap_jnp("fix", jnp.trunc))
     for name in _NP_FUNCS:
         if hasattr(_self, name) or not hasattr(jnp, name):
             continue

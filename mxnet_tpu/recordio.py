@@ -108,10 +108,16 @@ class MXRecordIO:
         self.close()
 
     def __del__(self):
+        import sys
         try:
             self.close()
-        except Exception:  # noqa: BLE001 — interpreter teardown: builtins
-            pass           # (open) may already be gone; nothing to save
+        except Exception:  # noqa: BLE001
+            # swallow ONLY during interpreter teardown (builtins like
+            # `open` may already be gone); a failing close during normal
+            # GC — e.g. the .idx sidecar write hitting a full disk —
+            # must stay visible
+            if not sys.is_finalizing():
+                raise
 
 
 class MXIndexedRecordIO(MXRecordIO):
@@ -162,7 +168,11 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def _native_scan(self):
         """Framing scan via the native lib, cached per open() (one C pass,
-        reused by every read_batch); None when unavailable/unreadable."""
+        reused by every read_batch); None when unavailable/unreadable.
+        Kept as the raw (record_starts, offsets, lengths) uint64 arrays —
+        an ImageNet-scale .rec has ~1.3M records, and a python dict of
+        boxed ints would cost hundreds of MB; searchsorted resolves
+        sidecar positions instead."""
         from . import native
         if self._scan_cache is None:
             try:
@@ -173,9 +183,7 @@ class MXIndexedRecordIO(MXRecordIO):
                 self._scan_cache = False
             else:
                 offs, lens = scan
-                self._scan_cache = {
-                    int(o) - 8: (int(o), int(ln))
-                    for o, ln in zip(offs.tolist(), lens.tolist())}
+                self._scan_cache = (offs - 8, offs, lens)  # sorted starts
         return self._scan_cache or None
 
     def read_batch(self, indices):
@@ -187,19 +195,21 @@ class MXIndexedRecordIO(MXRecordIO):
             # the python path raises here too; the native lane must not
             # silently read a half-flushed file
             raise MXNetError("read_batch: file opened for writing")
-        positions = [self.idx[self.key_type(i)] for i in indices]
-        by_pos = self._native_scan() if native.native_available() else None
-        if by_pos is not None:
-            try:
-                sel = [by_pos[int(p)] for p in positions]
-                res = native.read_recordio_batch(
-                    self.uri,
-                    _np.asarray([s[0] for s in sel], _np.uint64),
-                    _np.asarray([s[1] for s in sel], _np.uint64))
-                if res is not None:
-                    return res
-            except (KeyError, MXNetError):
-                pass              # sidecar/framing disagreement → fallback
+        positions = _np.asarray([self.idx[self.key_type(i)]
+                                 for i in indices], _np.uint64)
+        scan = self._native_scan() if native.native_available() else None
+        if scan is not None:
+            starts, offs, lens = scan
+            rows = _np.searchsorted(starts, positions)
+            ok = len(starts) > 0 and (rows < len(starts)).all()
+            if ok and (starts[rows] == positions).all():
+                try:
+                    res = native.read_recordio_batch(
+                        self.uri, offs[rows], lens[rows])
+                    if res is not None:
+                        return res
+                except MXNetError:
+                    pass          # framing disagreement → fallback
         return [self.read_idx(self.key_type(i)) for i in indices]
 
 

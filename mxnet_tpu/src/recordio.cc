@@ -24,6 +24,7 @@ constexpr int kErrFormat = -2;
 constexpr int kErrSplitRecord = -3;
 constexpr int kErrIo = -4;
 constexpr int kErrCapacity = -5;
+constexpr int kErrOom = -6;
 
 struct File {
   FILE* f;
@@ -70,8 +71,12 @@ int rio_index(const char* path, uint64_t** offsets, uint64_t** lengths,
   *count = offs.size();
   *offsets = static_cast<uint64_t*>(std::malloc(offs.size() * 8));
   *lengths = static_cast<uint64_t*>(std::malloc(lens.size() * 8));
-  if ((offs.size() && !*offsets) || (lens.size() && !*lengths))
-    return kErrIo;
+  if ((offs.size() && !*offsets) || (lens.size() && !*lengths)) {
+    std::free(*offsets);  // free(nullptr) is a no-op
+    std::free(*lengths);
+    *offsets = *lengths = nullptr;
+    return kErrOom;
+  }
   std::memcpy(*offsets, offs.data(), offs.size() * 8);
   std::memcpy(*lengths, lens.data(), lens.size() * 8);
   return kOk;
