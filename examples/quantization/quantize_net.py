@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""INT8 post-training quantization of a model-zoo CNN
+(reference example/quantization/imagenet_gen_qsym_mkldnn.py workflow →
+mx.contrib.quantization.quantize_net on the MXU int8 path).
+
+Calibrates on synthetic batches, converts Dense/Conv2D to int8, and
+reports float-vs-int8 top-1 agreement plus latency for both.
+
+Note on the timings: quantized nets run layer-by-layer on the imperative
+path (each int8 op jit-cached individually), so at tiny batch sizes the
+numbers are dominated by per-op dispatch, not MXU math — use them to
+compare against the same-regime float eager numbers, not as kernel
+throughput (the op-level int8 speed story lives in benchmark/opperf).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def run(model="resnet18_v1", batch=8, image_size=32, classes=10,
+        calib_mode="entropy", calib_batches=4, log=True):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.contrib import quantization as qz
+
+    mx.random.seed(0)
+    net = vision.get_model(model, classes=classes)
+    net.initialize(mx.init.Xavier())
+    r = np.random.RandomState(1)
+    x = nd.array(r.randn(batch, 3, image_size, image_size)
+                 .astype(np.float32))
+
+    def bench(fn, n=5):
+        fn(x).asnumpy()                     # warm/compile
+        t0 = time.time()
+        for _ in range(n):
+            out = fn(x)
+        out.asnumpy()
+        return (time.time() - t0) / n * 1000
+
+    ref = net(x).asnumpy()
+    t_fp = bench(net)
+    calib = [nd.array(r.randn(batch, 3, image_size, image_size)
+                      .astype(np.float32)) for _ in range(calib_batches)]
+    calib.append(x)
+    qz.quantize_net(net, calib_data=calib, calib_mode=calib_mode)
+    out = net(x).asnumpy()
+    t_int8 = bench(net)
+    rec = {"model": model, "calib_mode": calib_mode,
+           "top1_agreement": round(
+               float((out.argmax(1) == ref.argmax(1)).mean()), 4),
+           "max_rel_err": round(
+               float(np.abs(out - ref).max() / np.abs(ref).max()), 4),
+           "fp_ms": round(t_fp, 2), "int8_ms": round(t_int8, 2)}
+    if log:
+        print(json.dumps(rec))
+    return rec
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet18_v1")
+    p.add_argument("--calib-mode", default="entropy",
+                   choices=["none", "naive", "entropy"])
+    a = p.parse_args()
+    run(model=a.model, calib_mode=a.calib_mode)
+
+
+if __name__ == "__main__":
+    main()

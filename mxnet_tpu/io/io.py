@@ -414,7 +414,19 @@ class ImageRecordIter(DataIter):
         self._order = _np.arange(len(self._keys))
         self._cursor = -batch_size
         self._threads = max(1, preprocess_threads)
+        self._pool = None       # decode pool, created lazily, reused
         self.reset()
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
     @property
     def provide_data(self):
@@ -473,10 +485,13 @@ class ImageRecordIter(DataIter):
         # BEFORE fanning out: per-thread read_idx would race seek/read on
         # the shared file handle, and the C scan beats per-record seeks
         raws = self._rec.read_batch([self._keys[i] for i in idxs])
-        from concurrent.futures import ThreadPoolExecutor
         if self._threads > 1:
-            with ThreadPoolExecutor(self._threads) as ex:
-                results = list(ex.map(self._decode_one, raws))
+            if self._pool is None:
+                # one pool for the iterator's lifetime — spawning/joining
+                # worker threads per batch would tax the decode hot path
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(self._threads)
+            results = list(self._pool.map(self._decode_one, raws))
         else:
             results = [self._decode_one(r) for r in raws]
         imgs = _np.stack([r[0] for r in results])

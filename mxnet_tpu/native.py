@@ -57,8 +57,15 @@ def _compile(out_path):
     # half-written ELF
     tmp = f"{out_path}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
-    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    os.replace(tmp, out_path)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out_path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)   # failed/timed-out compile must not litter
+            except OSError:
+                pass
 
 
 def _fresh(so_path):
@@ -105,7 +112,15 @@ def recordio_lib():
                     _compile(cand)
                 _lib = _bind(cand)
                 return _lib
-            except Exception:  # noqa: BLE001 — any failure → next candidate
+            except Exception:  # noqa: BLE001
+                # rebuild failed (no toolchain?) — a stale-by-mtime but
+                # loadable prebuilt binary beats losing the native lane
+                if os.path.exists(cand):
+                    try:
+                        _lib = _bind(cand)
+                        return _lib
+                    except Exception:  # noqa: BLE001
+                        pass
                 continue
         return None
 

@@ -111,6 +111,8 @@ class MXRecordIO:
         import sys
         try:
             self.close()
+        except AttributeError:
+            pass   # constructor failed before attrs existed — nothing open
         except Exception:  # noqa: BLE001
             # swallow ONLY during interpreter teardown (builtins like
             # `open` may already be gone); a failing close during normal

@@ -58,6 +58,14 @@ def test_ssd_trains_and_detects():
     assert rec["mean_top_iou"] > 0.05     # detections overlap ground truth
 
 
+def test_quantize_net_example():
+    mod = _load("quantization/quantize_net.py")
+    rec = mod.run(model="resnet18_v1", batch=4, image_size=32, classes=10,
+                  calib_mode="naive", calib_batches=2, log=False)
+    assert rec["top1_agreement"] >= 0.75
+    assert rec["max_rel_err"] < 0.2
+
+
 def test_matrix_factorization_model_parallel():
     mod = _load("model_parallel/matrix_factorization.py")
     rec = mod.run(num_users=64, num_items=64, factor=16, batch=64,
