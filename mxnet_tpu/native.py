@@ -114,10 +114,18 @@ def recordio_lib():
                 return _lib
             except Exception:  # noqa: BLE001
                 # rebuild failed (no toolchain?) — a stale-by-mtime but
-                # loadable prebuilt binary beats losing the native lane
+                # loadable prebuilt binary beats losing the native lane,
+                # but say so: silently-old scanner behavior must be
+                # diagnosable
                 if os.path.exists(cand):
                     try:
                         _lib = _bind(cand)
+                        import warnings
+                        warnings.warn(
+                            f"mxnet_tpu.native: using prebuilt {cand} older "
+                            "than src/recordio.cc (recompile failed); "
+                            "native scanner behavior may predate source "
+                            "fixes", RuntimeWarning, stacklevel=2)
                         return _lib
                     except Exception:  # noqa: BLE001
                         pass

@@ -270,3 +270,57 @@ def test_sync_batch_norm_and_contrib_layers():
     conc.add(cnn.Identity())
     conc.add(cnn.Identity())
     assert conc(x).shape == (2, 8, 3, 3)
+
+
+def test_deformable_conv_numeric_gradient():
+    """Finite-difference check through the bilinear-gather deformable conv
+    (test_utils.check_numeric_gradient, the reference's universal grad
+    oracle)."""
+    r = np.random.RandomState(5)
+    x = nd.array(r.randn(1, 2, 6, 6).astype(np.float32))
+    w = nd.array(r.randn(2, 2, 3, 3).astype(np.float32) * 0.5)
+    off = nd.array((r.randn(1, 18, 4, 4) * 0.3).astype(np.float32))
+    x.attach_grad(); w.attach_grad(); off.attach_grad()
+    with autograd.record():
+        out = nd.contrib.DeformableConvolution(x, off, w, kernel=(3, 3),
+                                               num_filter=2)
+        loss = (out * out).sum()
+    loss.backward()
+    eps = 1e-2
+    xn = x.asnumpy()
+    for (i, j) in [(0, 0), (1, 3)]:
+        pert = xn.copy(); pert[0, 0, i, j] += eps
+        lp = float((nd.contrib.DeformableConvolution(
+            nd.array(pert), off, w, kernel=(3, 3), num_filter=2) ** 2)
+            .sum().asnumpy())
+        pert[0, 0, i, j] -= 2 * eps
+        lm = float((nd.contrib.DeformableConvolution(
+            nd.array(pert), off, w, kernel=(3, 3), num_filter=2) ** 2)
+            .sum().asnumpy())
+        fd = (lp - lm) / (2 * eps)
+        np.testing.assert_allclose(x.grad.asnumpy()[0, 0, i, j], fd,
+                                   rtol=0.05, atol=0.05)
+
+
+def test_psroi_pooling_gradient_flows():
+    data = nd.array(np.random.RandomState(6)
+                    .randn(1, 8, 6, 6).astype(np.float32))
+    rois = nd.array(np.array([[0, 0, 0, 5, 5]], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.contrib.PSROIPooling(data, rois, output_dim=2,
+                                      pooled_size=2, group_size=2)
+        loss = out.sum()
+    loss.backward()
+    g = data.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_fft_gradient_roundtrip():
+    x = nd.array(np.random.RandomState(7).randn(2, 8).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        loss = (nd.contrib.ifft(nd.contrib.fft(x)) / 8).sum()
+    loss.backward()
+    # d/dx sum(ifft(fft(x))/n) == 1 elementwise (linear roundtrip)
+    np.testing.assert_allclose(x.grad.asnumpy(), 1.0, rtol=1e-4, atol=1e-4)

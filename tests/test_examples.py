@@ -58,6 +58,12 @@ def test_ssd_trains_and_detects():
     assert rec["mean_top_iou"] > 0.05     # detections overlap ground truth
 
 
+def test_moe_example_expert_parallel():
+    mod = _load("moe/train_moe.py")
+    rec = mod.run(steps=12, dp=2, ep=4, log=False)
+    assert rec["last_loss"] < rec["first_loss"]
+
+
 def test_quantize_net_example():
     mod = _load("quantization/quantize_net.py")
     rec = mod.run(model="resnet18_v1", batch=4, image_size=32, classes=10,
