@@ -47,10 +47,8 @@ def run(units=32, hidden=64, experts=4, k=2, batch=64, steps=30, dp=1,
     net = MoEBlock()
     net.initialize(mx.init.Xavier())
     import jax
-    if dp * ep > 1:
-        mesh = DeviceMesh(shape=(dp, ep), axis_names=("dp", "ep"))
-    else:
-        mesh = DeviceMesh(devices=jax.devices()[:1])
+    mesh = DeviceMesh(shape=(dp, ep), axis_names=("dp", "ep"),
+                      devices=jax.devices()[:dp * ep])
 
     def loss_fn(out, label):
         logits, aux = out
@@ -77,8 +75,7 @@ def run(units=32, hidden=64, experts=4, k=2, batch=64, steps=30, dp=1,
     probs /= probs.sum(-1, keepdims=True)
     util = np.bincount(probs.argmax(-1), minlength=experts) / len(probs)
     # Switch aux on this batch: E * sum(top1 fraction * mean router prob)
-    f = np.bincount(probs.argmax(-1), minlength=experts) / len(probs)
-    aux_final = float(experts * (f * probs.mean(0)).sum())
+    aux_final = float(experts * (util * probs.mean(0)).sum())
     rec = {"first_loss": round(losses[0], 4),
            "last_loss": round(losses[-1], 4),
            "aux_loss": round(aux_final, 4),

@@ -58,6 +58,13 @@ def test_ssd_trains_and_detects():
     assert rec["mean_top_iou"] > 0.05     # detections overlap ground truth
 
 
+def test_pipeline_example_dp_pp():
+    mod = _load("pipeline/train_pipeline.py")
+    rec = mod.run(depth=4, pp=4, dp=2, steps=15, log=False)
+    assert rec["last_loss"] < rec["first_loss"]
+    assert rec["bubble_fraction"] < 0.5
+
+
 def test_moe_example_expert_parallel():
     mod = _load("moe/train_moe.py")
     rec = mod.run(steps=12, dp=2, ep=4, log=False)
