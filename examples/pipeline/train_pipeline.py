@@ -82,8 +82,15 @@ def main():
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--pp", type=int, default=4)
     p.add_argument("--steps", type=int, default=25)
+    p.add_argument("--microbatches", type=int, default=8,
+                   help="GPipe knob: bubble = (pp-1)/(M+pp-1)")
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--width", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.2)
     a = p.parse_args()
-    run(depth=a.depth, dp=a.dp, pp=a.pp, steps=a.steps)
+    run(depth=a.depth, width=a.width, batch=a.batch,
+        microbatches=a.microbatches, dp=a.dp, pp=a.pp, steps=a.steps,
+        lr=a.lr)
 
 
 if __name__ == "__main__":

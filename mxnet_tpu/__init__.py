@@ -80,7 +80,7 @@ def __getattr__(name):
               "parallel", "test_utils", "recordio", "callback", "model",
               "util", "numpy", "numpy_extension", "contrib", "amp", "module",
               "monitor", "checkpoint", "dmlc_params", "operator",
-              "pipeline"}
+              "pipeline", "name", "attribute", "rtc", "native"}
     if name in lazies:
         mod = _lazy(name)
         globals()[name] = mod
@@ -111,4 +111,9 @@ def __getattr__(name):
         mod = _lazy("initializer")
         globals()["init"] = mod
         return mod
+    if name == "AttrScope":
+        # reference exposes mx.AttrScope at top level
+        from .attribute import AttrScope
+        globals()["AttrScope"] = AttrScope
+        return AttrScope
     raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

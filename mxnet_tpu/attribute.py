@@ -1,0 +1,55 @@
+"""mx.attribute — AttrScope (reference python/mxnet/attribute.py).
+
+``with mx.AttrScope(__ctx_group__='dev1'):`` attaches string attributes to
+every symbol created inside the scope — the mechanism behind group2ctx
+model parallelism and lr_mult/wd_mult symbol annotations upstream.  Here
+the dunder attrs ride along in ``Symbol._attrs`` (excluded from operator
+kwargs at execution) and are consumed by whatever pass cares — e.g.
+``__ctx_group__`` maps to mesh-axis assignment per SURVEY §7.1 N6.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope"]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = [AttrScope()]
+    return _tls.stack
+
+
+class AttrScope:
+    def __init__(self, **attrs):
+        for k in attrs:
+            if not (k.startswith("__") and k.endswith("__")):
+                raise ValueError(
+                    f"AttrScope keys must be __dunder__ strings, got {k!r} "
+                    "(reference convention: user attrs are namespaced)")
+        self._attrs = {k: str(v) for k, v in attrs.items()}
+
+    @staticmethod
+    def current():
+        return _stack()[-1]
+
+    def get(self, attrs=None):
+        """Merge scope attrs under explicitly-passed ones."""
+        if not self._attrs:
+            return dict(attrs or {})
+        out = dict(self._attrs)
+        out.update(attrs or {})
+        return out
+
+    def __enter__(self):
+        # nested scopes accumulate (reference behavior)
+        merged = AttrScope()
+        merged._attrs = {**AttrScope.current()._attrs, **self._attrs}
+        _stack().append(merged)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()

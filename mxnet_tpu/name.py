@@ -1,0 +1,59 @@
+"""mx.name — symbol auto-naming (reference python/mxnet/name.py).
+
+``NameManager`` hands each anonymous symbol a unique, readable name
+(``dot0``, ``fullyconnected1``, …) from per-hint counters; ``Prefix``
+prepends a fixed prefix (the building block under Gluon's name scopes).
+Thread-local stack, context-manager protocol — same surface as upstream.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix"]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = [NameManager()]
+    return _tls.stack
+
+
+class NameManager:
+    """Per-hint counters: get(None, 'dot') → 'dot0', 'dot1', …"""
+
+    def __init__(self):
+        self._counter = {}
+
+    @staticmethod
+    def current():
+        return _stack()[-1]
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return f"{hint}{n}"
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+
+
+class Prefix(NameManager):
+    """Auto-names carry a fixed prefix (reference name.py :: Prefix)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        return self._prefix + super().get(None, hint)

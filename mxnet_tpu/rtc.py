@@ -1,0 +1,30 @@
+"""mx.rtc — user runtime-compiled kernels (reference python/mxnet/rtc.py).
+
+Explicitly DROPPED on TPU with rationale (the SURVEY §7.4 three-way
+ledger): the reference's CudaModule compiles user CUDA C source via NVRTC
+at runtime; there is no CUDA on this stack, and the TPU-native equivalent
+of a hand kernel is a Pallas kernel (see ``mxnet_tpu/kernels/`` for
+worked examples) registered as a custom op via ``mx.operator.CustomOp``
+or used directly.  Importing the module works; constructing its classes
+raises with this guidance, mirroring how other dropped subsystems behave.
+"""
+
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["CudaModule", "CudaKernel"]
+
+_MSG = ("mx.rtc is CUDA-specific and not part of the TPU rebuild: write a "
+        "Pallas kernel instead (patterns in mxnet_tpu/kernels/) and expose "
+        "it as a custom op via mx.operator.CustomOp")
+
+
+class CudaModule:
+    def __init__(self, *args, **kwargs):  # noqa: ARG002
+        raise MXNetError(_MSG)
+
+
+class CudaKernel:
+    def __init__(self, *args, **kwargs):  # noqa: ARG002
+        raise MXNetError(_MSG)

@@ -11,21 +11,19 @@ from ..base import MXNetError
 from ..ops import registry as _reg
 from .symbol import Symbol, _make
 
-_counter = {}
-
-
-def _auto_name(opname):
-    base = opname.split(".")[-1].lower()
-    n = _counter.get(base, 0)
-    _counter[base] = n + 1
-    return f"{base}{n}"
+def _auto_name(opname, name=None):
+    # route through mx.name.NameManager so Prefix()/custom managers apply;
+    # hint derivation shared with symbol._make
+    from ..name import NameManager
+    from .symbol import _name_hint
+    return NameManager.current().get(name, _name_hint(opname))
 
 
 def _make_sym_func(op):
     def fn(*args, name=None, attr=None, **attrs):
         from .symbol import var
         inputs = [a for a in args if isinstance(a, Symbol)]
-        sym_name = name or _auto_name(op.name)
+        sym_name = _auto_name(op.name, name)
         if op.input_names is not None:
             # reference nnvm composition: keyword Symbols fill their named
             # slot; missing inputs become auto-created variables
@@ -64,8 +62,17 @@ def _make_sym_func(op):
                 f"composition rejects unplaceable inputs)")
         s = Symbol(op, inputs, attrs, name=sym_name,
                    num_outputs=op.num_outputs if op.num_outputs > 0 else 1)
+        from ..attribute import AttrScope
         if attr:
-            s._attrs.update(attr)
+            bad = [k for k in attr
+                   if not (k.startswith("__") and k.endswith("__"))]
+            if bad:
+                raise MXNetError(
+                    f"attr keys must be __dunder__ strings, got {bad} "
+                    "(non-dunder keys would collide with operator kwargs)")
+        scope_attr = AttrScope.current().get(attr)
+        if scope_attr:
+            s._attrs.update(scope_attr)
         return s
     fn.__name__ = op.name.split(".")[-1]
     fn.__doc__ = op.doc or f"symbolic wrapper for operator {op.name!r}"

@@ -239,7 +239,8 @@ class Symbol:
                         # a multi-output producer feeds its first output
                         # unless explicitly sliced (reference nnvm entries)
                         ins.append(x[0] if isinstance(x, (tuple, list)) else x)
-                    attrs = s._attrs
+                    attrs = {k: v for k, v in s._attrs.items()
+                             if not k.startswith("__")}
                     op = s._op
                     if op.wrap_train is not None or op.wrap_key is not None:
                         attrs = dict(attrs)
@@ -313,7 +314,9 @@ class Symbol:
                 in_shapes.append(v[0] if isinstance(v, list) else v)
             if s._op.infer_args is not None and any(
                     sh is None for sh in in_shapes):
-                filled = s._op.infer_args(in_shapes, s._attrs)
+                filled = s._op.infer_args(
+                    in_shapes, {k: v for k, v in s._attrs.items()
+                                if not k.startswith("__")})
                 for i, sh in zip(s._inputs, filled):
                     if sh is not None and shape_of.get(id(i)) is None \
                             and i._op is None:
@@ -326,8 +329,10 @@ class Symbol:
                        for i, sh in zip(s._inputs, in_shapes)]
             try:
                 out = jax.eval_shape(
-                    lambda *a, _s=s: _reg.invoke_arrays(_s._op, list(a),
-                                                        _s._attrs), *structs)
+                    lambda *a, _s=s: _reg.invoke_arrays(
+                        _s._op, list(a),
+                        {k: v for k, v in _s._attrs.items()
+                         if not k.startswith("__")}), *structs)
             except Exception as e:
                 raise MXNetError(
                     f"infer_shape failed at node {s._name!r}: {e}") from e
@@ -433,21 +438,33 @@ def _as_tuple(v):
     return (v,)
 
 
+def _name_hint(opname):
+    """NameManager hint for an op — ONE derivation shared with
+    symbol/register.py so both construction paths name alike."""
+    return opname.split(".")[-1].lower()
+
+
 def _make(opname, inputs, attrs, name=None):
     op = _reg.get(opname)
-    return Symbol(op, inputs, attrs,
-                  name=name or f"{opname.replace('.', '_')}{id(attrs) % 997}")
+    from ..name import NameManager
+    from ..attribute import AttrScope
+    return Symbol(op, inputs, AttrScope.current().get(attrs),
+                  name=NameManager.current().get(name, _name_hint(opname)))
 
 
 def var(name, attr=None, shape=None, dtype=None, init=None, stype=None,
         **kwargs):  # noqa: ARG001
+    from ..attribute import AttrScope
     s = Symbol(None, name=name)
     if shape is not None:
         s._attrs["__shape__"] = tuple(shape)
     if dtype is not None:
         s._attrs["__dtype__"] = dtype
-    if attr:
-        s._attrs.update(attr)
+    # scope attrs apply to Variables too — the reference's primary use
+    # (lr_mult/wd_mult/ctx_group annotations on parameters)
+    merged = AttrScope.current().get(attr)
+    if merged:
+        s._attrs.update(merged)
     s._attrs.update(kwargs)
     return s
 

@@ -1,0 +1,65 @@
+"""mx.name.NameManager / mx.AttrScope / mx.rtc (reference name.py,
+attribute.py, rtc.py — P21 misc infra)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_name_manager_auto_names():
+    with mx.name.NameManager():
+        a = mx.sym.Variable("x")
+        d1 = mx.sym.FullyConnected(a, num_hidden=4)
+        d2 = mx.sym.FullyConnected(a, num_hidden=4)
+    assert d1.name == "fullyconnected0"
+    assert d2.name == "fullyconnected1"
+    # explicit names always win
+    with mx.name.NameManager():
+        d3 = mx.sym.FullyConnected(a, num_hidden=4, name="fc")
+    assert d3.name == "fc"
+
+
+def test_name_prefix():
+    with mx.name.Prefix("enc_"):
+        s = mx.sym.softmax(mx.sym.Variable("x"))
+    assert s.name.startswith("enc_softmax")
+
+
+def test_attr_scope_attaches_and_execution_unaffected():
+    x = mx.sym.Variable("data")
+    with mx.AttrScope(__ctx_group__="dev1", __lr_mult__="2"):
+        y = mx.sym.FullyConnected(x, num_hidden=3, name="fc")
+    assert y.attr("__ctx_group__") == "dev1"
+    assert y.attr("__lr_mult__") == "2"
+    # dunder attrs must not leak into the operator kwargs: bind + forward
+    ex = y.simple_bind(mx.cpu(), data=(2, 5))
+    ex.forward(data=mx.nd.ones((2, 5)))
+    assert ex.outputs[0].shape == (2, 3)
+    # nested scopes accumulate; inner wins on conflict
+    with mx.AttrScope(__ctx_group__="a"):
+        with mx.AttrScope(__ctx_group__="b"):
+            z = mx.sym.relu(x)
+    assert z.attr("__ctx_group__") == "b"
+
+
+def test_attr_scope_rejects_non_dunder():
+    with pytest.raises(ValueError, match="dunder"):
+        mx.AttrScope(ctx_group="dev1")
+
+
+def test_rtc_dropped_with_rationale():
+    with pytest.raises(mx.MXNetError, match="Pallas"):
+        mx.rtc.CudaModule("kernel source")
+
+
+def test_attr_scope_applies_to_variables():
+    with mx.AttrScope(__lr_mult__="2"):
+        w = mx.sym.Variable("w")
+    assert w.attr("__lr_mult__") == "2"
+
+
+def test_non_dunder_attr_dict_rejected():
+    x = mx.sym.Variable("x")
+    with pytest.raises(mx.MXNetError, match="dunder"):
+        mx.sym.relu(x, attr={"mood": "happy"})
