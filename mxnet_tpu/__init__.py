@@ -80,7 +80,8 @@ def __getattr__(name):
               "parallel", "test_utils", "recordio", "callback", "model",
               "util", "numpy", "numpy_extension", "contrib", "amp", "module",
               "monitor", "checkpoint", "dmlc_params", "operator",
-              "pipeline", "name", "attribute", "rtc", "native"}
+              "pipeline", "name", "attribute", "rtc", "native",
+              "visualization"}
     if name in lazies:
         mod = _lazy(name)
         globals()[name] = mod
@@ -105,6 +106,11 @@ def __getattr__(name):
     if name == "kv":
         mod = _lazy("kvstore")
         globals()["kv"] = mod
+        return mod
+    if name == "viz":
+        # reference: `from . import visualization as viz`
+        mod = _lazy("visualization")
+        globals()["viz"] = mod
         return mod
     if name == "init":
         # reference: `from . import initializer as init` (python/mxnet/__init__.py)

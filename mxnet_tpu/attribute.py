@@ -45,11 +45,12 @@ class AttrScope:
         return out
 
     def __enter__(self):
-        # nested scopes accumulate (reference behavior)
+        # nested scopes accumulate (reference behavior); the bound object
+        # IS the merged scope so `as sc` agrees with AttrScope.current()
         merged = AttrScope()
         merged._attrs = {**AttrScope.current()._attrs, **self._attrs}
         _stack().append(merged)
-        return self
+        return merged
 
     def __exit__(self, *exc):
         _stack().pop()

@@ -239,8 +239,7 @@ class Symbol:
                         # a multi-output producer feeds its first output
                         # unless explicitly sliced (reference nnvm entries)
                         ins.append(x[0] if isinstance(x, (tuple, list)) else x)
-                    attrs = {k: v for k, v in s._attrs.items()
-                             if not k.startswith("__")}
+                    attrs = _op_attrs(s)
                     op = s._op
                     if op.wrap_train is not None or op.wrap_key is not None:
                         attrs = dict(attrs)
@@ -314,9 +313,7 @@ class Symbol:
                 in_shapes.append(v[0] if isinstance(v, list) else v)
             if s._op.infer_args is not None and any(
                     sh is None for sh in in_shapes):
-                filled = s._op.infer_args(
-                    in_shapes, {k: v for k, v in s._attrs.items()
-                                if not k.startswith("__")})
+                filled = s._op.infer_args(in_shapes, _op_attrs(s))
                 for i, sh in zip(s._inputs, filled):
                     if sh is not None and shape_of.get(id(i)) is None \
                             and i._op is None:
@@ -330,9 +327,7 @@ class Symbol:
             try:
                 out = jax.eval_shape(
                     lambda *a, _s=s: _reg.invoke_arrays(
-                        _s._op, list(a),
-                        {k: v for k, v in _s._attrs.items()
-                         if not k.startswith("__")}), *structs)
+                        _s._op, list(a), _op_attrs(_s)), *structs)
             except Exception as e:
                 raise MXNetError(
                     f"infer_shape failed at node {s._name!r}: {e}") from e
@@ -436,6 +431,13 @@ def _as_tuple(v):
     if isinstance(v, list):
         return tuple(v)
     return (v,)
+
+
+def _op_attrs(s):
+    """Operator kwargs for a node: Symbol._attrs minus the __dunder__
+    string annotations (AttrScope/shape/aux markers) — the ONE exclusion
+    rule every execution/inference site shares."""
+    return {k: v for k, v in s._attrs.items() if not k.startswith("__")}
 
 
 def _name_hint(opname):

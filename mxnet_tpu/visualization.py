@@ -1,0 +1,101 @@
+"""mx.viz — network visualization (reference python/mxnet/visualization.py).
+
+``print_summary(symbol, shape=...)`` prints the reference-style layer
+table (name, output shape, param count, previous layers) and returns the
+total parameter count; ``plot_network`` renders a graphviz Digraph when
+the ``graphviz`` package is importable and raises with guidance
+otherwise (the sandbox image does not ship it).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _node_params(sym, shape_of, data_names):
+    """Parameter count attributable to one op node = total size of its
+    direct Variable inputs (weights/biases), like the reference summary;
+    data inputs (the shapes the caller provided) are not parameters."""
+    total = 0
+    for i in sym._inputs:
+        if i._op is None and not i._attrs.get("__aux__") \
+                and i._name not in data_names:
+            shp = shape_of.get(i._name)
+            if shp:
+                total += int(_np.prod(shp))
+    return total
+
+
+def print_summary(symbol, shape=None, line_length=98, positions=None):
+    """Layer-table summary (reference visualization.py :: print_summary).
+
+    ``shape`` — dict of input-name → shape enabling shape inference.
+    Returns the total parameter count.
+    """
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    arg_shapes = {}
+    out_shape_of = {}
+    data_names = set(shape or {})
+    if shape:
+        internals = symbol.get_internals()
+        arg_s, out_s, _ = internals.infer_shape(**shape)
+        if arg_s is not None:
+            arg_shapes = dict(zip(internals.list_arguments(), arg_s))
+        # a multi-output node contributes num_outputs entries to out_s:
+        # consume them per node, keep the first (visible) output's shape
+        pos = 0
+        for node in internals._inputs:
+            n_out = node.num_outputs
+            if out_s is not None and pos < len(out_s):
+                out_shape_of[node._name] = out_s[pos]
+            pos += n_out
+
+    cols = [int(line_length * p) for p in positions]
+
+    def row(fields):
+        line = ""
+        for text, col in zip(fields, cols):
+            line = (line + str(text))[:col - 1].ljust(col)
+        print(line)
+
+    print("=" * line_length)
+    row(["Layer (type)", "Output Shape", "Param #", "Previous Layer"])
+    print("=" * line_length)
+    total = 0
+    nodes = [s for s in symbol._walk() if s._op is not None]
+    for s in nodes:
+        op_name = s._op if isinstance(s._op, str) else s._op.name
+        n_par = _node_params(s, arg_shapes, data_names)
+        total += n_par
+        prev = ",".join(i._name for i in s._inputs if i._op is not None) \
+            or ",".join(i._name for i in s._inputs[:1])
+        out_sh = out_shape_of.get(s._name, "")
+        row([f"{s._name} ({op_name})", out_sh, n_par, prev])
+    print("=" * line_length)
+    print(f"Total params: {total}")
+    print("=" * line_length)
+    return total
+
+
+def plot_network(symbol, title="plot", shape=None, node_attrs=None,
+                 save_format="pdf"):  # noqa: ARG001
+    """Graphviz Digraph of the symbol graph (reference plot_network)."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise MXNetError(
+            "plot_network needs the `graphviz` python package (not in this "
+            "environment); use print_summary for a text view") from e
+    dot = Digraph(name=title, format=save_format)
+    for s in symbol._walk():
+        label = s._name if s._op is None else \
+            f"{s._name}\\n{(s._op if isinstance(s._op, str) else s._op.name)}"
+        dot.node(str(id(s)), label,
+                 shape="oval" if s._op is None else "box")
+        for i in s._inputs:
+            dot.edge(str(id(i)), str(id(s)))
+    return dot

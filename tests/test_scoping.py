@@ -63,3 +63,27 @@ def test_non_dunder_attr_dict_rejected():
     x = mx.sym.Variable("x")
     with pytest.raises(mx.MXNetError, match="dunder"):
         mx.sym.relu(x, attr={"mood": "happy"})
+
+
+def test_viz_print_summary():
+    x = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(x, num_hidden=16,
+                                                name="fc1"),
+                          act_type="relu", name="act1")
+    out = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    total = mx.viz.print_summary(out, shape={"data": (2, 8)})
+    assert total == (16 * 8 + 16) + (4 * 16 + 4)
+
+
+def test_viz_plot_network():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.relu(mx.sym.FullyConnected(x, num_hidden=2, name="fc"))
+    try:
+        import graphviz  # noqa: F401
+    except ImportError:
+        with pytest.raises(mx.MXNetError, match="graphviz"):
+            mx.viz.plot_network(sym)
+        return
+    dot = mx.viz.plot_network(sym)
+    src = dot.source
+    assert "fc" in src and "->" in src
