@@ -3,11 +3,14 @@
 Blockwise-softmax attention with O(L) memory: probabilities never
 materialize in HBM (SURVEY §5.7; replaces the reference's full
 softmax(QK^T) path in src/operator/contrib/transformer.cc).  Written
-in-house rather than wrapping jax.experimental's kernel because (a) this
+in-house rather than wrapping jax.experimental's kernel because this
 framework runs with jax_enable_x64 on (MXNet float64 parity) and the
-upstream kernel's index arithmetic miscompiles under x64 — everything here
-pins explicit int32/float32 types — and (b) it is the building block the
-ring-attention sequence-parallel path composes with.
+upstream kernel's index arithmetic miscompiles under x64 — everything
+here pins explicit int32/float32 types, including BlockSpec index-map
+literals (see ``_zi``).  This kernel is the TPU branch of
+``contrib.masked_selfatt`` / ``contrib.masked_att_qkv``
+(``ops/contrib.py::_attend``), gated by a one-time compile probe that
+falls back to the dense fp32 path on toolchains that reject the IR.
 
 Layout: q, k, v are (batch, heads, seq, head_dim); segment ids are
 (batch, seq) int32 — attention only flows between positions with EQUAL
@@ -36,6 +39,14 @@ _LANES = 128     # VPU lane width: per-row scalars are stored broadcast over lan
 _SUBLANES = 8    # min sublane count — kv segment ids ride a (8, bk) tile
 
 
+def _zi():
+    """int32 zero for BlockSpec index maps.  Under jax_enable_x64 (this
+    framework's default, MXNet float64 parity) a literal ``0`` in an index
+    map becomes an i64 constant that Mosaic fails to legalize
+    ('func.return (i32, i32, i32, i64)'); an explicit int32 compiles."""
+    return jnp.int32(0)
+
+
 def _mask_block(sq_ref, skv_ref, causal, iq, ik, bq, bk):
     """(bq, bk) bool mask for one tile; int32 iota only (x64-safe).
 
@@ -48,6 +59,21 @@ def _mask_block(sq_ref, skv_ref, causal, iq, ik, bq, bk):
     if causal:
         qi = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
         ki = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
+        mask = jnp.logical_and(mask, qi >= ki)
+    return mask
+
+
+def _mask_block_T(sqT_ref, skvT_ref, causal, iq, ik, bq, bk):
+    """(bk, bq) mask — the TRANSPOSED tile for the dk/dv kernel, built
+    directly from transposed segment layouts (sqT (1, SUBLANES, bq) q ids
+    over lanes, skvT (1, bk, LANES) kv ids over sublanes) because Mosaic
+    cannot legalize a bool vector transpose (`tpu.transpose` on i1)."""
+    sq = sqT_ref[0][:1, :]         # (1, bq)
+    skv = skvT_ref[0][:, :1]       # (bk, 1)
+    mask = skv == sq               # (bk, bq)
+    if causal:
+        ki = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0) + ik * bk
+        qi = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1) + iq * bq
         mask = jnp.logical_and(mask, qi >= ki)
     return mask
 
@@ -120,15 +146,15 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, scale, block_q, block_k, interpret):
                           n_kv=n_kv),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda b, h, i, j: (b, i, 0)),
-            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, i, j: (b, 0, j)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
+            pl.BlockSpec((1, bq, _LANES), lambda b, h, i, j: (b, i, _zi())),
+            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, i, j: (b, _zi(), j)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq, _LANES), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
+            pl.BlockSpec((1, 1, bq, _LANES), lambda b, h, i, j: (b, h, i, _zi())),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
@@ -187,7 +213,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                sq_ref, skv_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                sqT_ref, skvT_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                 *, causal, scale, n_q):
     ik = pl.program_id(2)   # kv block: outer
     iq = pl.program_id(3)   # q block: inner (sequential accumulation)
@@ -210,8 +236,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k, q, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.DEFAULT) * jnp.float32(scale)
-    mask = _mask_block(sq_ref, skv_ref, causal, iq, ik, bq, bk)
-    pT = jnp.where(mask.T, jnp.exp(sT - lse[:, 0][None, :]), jnp.float32(0.0))  # (bk, bq)
+    maskT = _mask_block_T(sqT_ref, skvT_ref, causal, iq, ik, bq, bk)
+    pT = jnp.where(maskT, jnp.exp(sT - lse[:, 0][None, :]), jnp.float32(0.0))  # (bk, bq)
     dv_scr[...] += jax.lax.dot_general(
         pT.astype(do.dtype), do, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -244,47 +270,51 @@ def _bwd(q, k, v, seg_q, seg_kv, out, lse, do, causal, scale,
                     axis=-1)                                   # (B, H, Lq)
     lse_b = jnp.broadcast_to(lse[..., None], lse.shape + (_LANES,))
     delta_b = jnp.broadcast_to(delta[..., None], delta.shape + (_LANES,))
-    seg_q = jnp.broadcast_to(seg_q[:, :, None], (B, Lq, _LANES))
-    seg_kv = jnp.broadcast_to(seg_kv[:, None, :], (B, _SUBLANES, Lk))
+    # two layouts of each segment-id vector: per-sublane-row for the dq
+    # kernel's (bq, bk) mask, per-lane for the dkv kernel's (bk, bq) mask
+    seg_qr = jnp.broadcast_to(seg_q[:, :, None], (B, Lq, _LANES))
+    seg_kvl = jnp.broadcast_to(seg_kv[:, None, :], (B, _SUBLANES, Lk))
+    seg_qT = jnp.broadcast_to(seg_q[:, None, :], (B, _SUBLANES, Lq))
+    seg_kvT = jnp.broadcast_to(seg_kv[:, :, None], (B, Lk, _LANES))
 
-    row_spec = pl.BlockSpec((1, 1, bq, _LANES), lambda b, h, i, j: (b, h, i, 0))
+    row_spec = pl.BlockSpec((1, 1, bq, _LANES), lambda b, h, i, j: (b, h, i, _zi()))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale, n_kv=n_kv),
         grid=(B, H, n_q, n_kv),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
             row_spec,
             row_spec,
-            pl.BlockSpec((1, bq, _LANES), lambda b, h, i, j: (b, i, 0)),
-            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, i, j: (b, 0, j)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, h, i, j: (b, i, _zi())),
+            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, i, j: (b, _zi(), j)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b, seg_q, seg_kv)
+    )(q, k, v, do, lse_b, delta_b, seg_qr, seg_kvl)
 
     row_spec_T = pl.BlockSpec((1, 1, bq, _LANES),
-                              lambda b, h, j, i: (b, h, i, 0))
+                              lambda b, h, j, i: (b, h, i, _zi()))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale, n_q=n_q),
         grid=(B, H, n_kv, n_q),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, _zi())),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, _zi())),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, _zi())),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, _zi())),
             row_spec_T,
             row_spec_T,
-            pl.BlockSpec((1, bq, _LANES), lambda b, h, j, i: (b, i, 0)),
-            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, j, i: (b, 0, j)),
+            pl.BlockSpec((1, _SUBLANES, bq), lambda b, h, j, i: (b, _zi(), i)),
+            pl.BlockSpec((1, bk, _LANES), lambda b, h, j, i: (b, j, _zi())),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, _zi())),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, _zi())),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -295,7 +325,7 @@ def _bwd(q, k, v, seg_q, seg_kv, out, lse, do, causal, scale,
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b, seg_q, seg_kv)
+    )(q, k, v, do, lse_b, delta_b, seg_qT, seg_kvT)
     return dq, dk, dv
 
 

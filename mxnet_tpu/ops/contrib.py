@@ -65,11 +65,15 @@ _PALLAS_PROBE = [None]  # None=unknown, True/False=probed
 
 
 def _pallas_compiles():
-    """One-time probe: can the active TPU toolchain compile a Pallas flash
-    kernel?  The axon remote-compile helper ships its own libtpu whose
-    Mosaic pass pipeline can lag the local jax — when it rejects the
-    kernel IR (verification/legalization errors), every caller must fall
-    back to the dense path instead of crashing the program."""
+    """One-time probe: can the active TPU toolchain compile the in-house
+    Pallas flash kernel (``mxnet_tpu.kernels.flash_attention``)?  The axon
+    remote-compile helper ships its own libtpu whose Mosaic pass pipeline
+    can lag the local jax — when it rejects the kernel IR (verification/
+    legalization errors), every caller must fall back to the dense path
+    instead of crashing the program.  The in-house kernel pins int32
+    everywhere (index-map literals included) precisely because this
+    toolchain miscompiles i64 index arithmetic under jax_enable_x64 —
+    the upstream jax.experimental kernel does not and fails here."""
     if _PALLAS_PROBE[0] is not None:
         return _PALLAS_PROBE[0]
     import jax
@@ -81,8 +85,7 @@ def _pallas_compiles():
     try:
         import numpy as _onp
         import ml_dtypes
-        from jax.experimental.pallas.ops.tpu.flash_attention import (
-            flash_attention, SegmentIds)
+        from ..kernels.flash_attention import flash_attention
         seg = jax.numpy.ones((2, 128), jax.numpy.int32)
         # probe the SAME configurations masked_selfatt lowers: segment ids
         # exercise the index arithmetic that breaks under x64 toolchains,
@@ -90,12 +93,11 @@ def _pallas_compiles():
         # their own, and B/H > 1 keeps the grid index math from constant-
         # folding away — forward + grad in both dtypes must all compile
         for dt in (_onp.float32, ml_dtypes.bfloat16):
-            for causal in (False, True):  # causal uses a different grid
+            for causal in (False, True):  # causal masks a different tile set
                 x = jax.numpy.asarray(_onp.zeros((2, 2, 128, 64), dt))
 
                 def f(q, k, v, _c=causal):
-                    out = flash_attention(
-                        q, k, v, segment_ids=SegmentIds(seg, seg), causal=_c)
+                    out = flash_attention(q, k, v, seg, seg, _c, 0.125)
                     return out.astype(jax.numpy.float32).sum()
 
                 jax.block_until_ready(
@@ -179,12 +181,10 @@ def _attend(q, k, v, valid_length, causal):
         .astype(jnp.int32)                          # (B, L): 1=valid, 0=pad
     if _flash_eligible(L, D):
         import jax
-        from jax.experimental.pallas.ops.tpu.flash_attention import (
-            flash_attention, SegmentIds)
+        from ..kernels.flash_attention import flash_attention
 
         def _tpu(q, k, v, seg):
-            return flash_attention(q, k, v, segment_ids=SegmentIds(seg, seg),
-                                   causal=causal, sm_scale=scale)
+            return flash_attention(q, k, v, seg, seg, causal, scale)
 
         def _portable(q, k, v, seg):
             return _dense_sdpa(q, k, v, seg, causal, scale)

@@ -1,0 +1,181 @@
+"""In-house Pallas flash-attention kernel tests (VERDICT r3 item 1).
+
+Interpreter-mode parity on the CPU platform: ``kernels/flash_attention.py``
+forward + custom backward against the dense fp32 oracle
+(``ops/contrib.py::_dense_sdpa``), across causal x segment-masking x dtypes
+— the same configuration grid the on-chip compile probe walks.  The real-
+chip cross-check lives in ``test_tpu_smoke.py`` (flash-vs-dense on the TPU).
+
+Reference role: src/operator/contrib/transformer.cc fused attention ops
+(SURVEY §5.7 — the long-context O(L)-memory requirement).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.kernels.flash_attention import flash_attention
+from mxnet_tpu.ops.contrib import _dense_sdpa
+
+
+def _inputs(dt, B=2, H=2, L=256, D=64, valid=(200, 256), seed=7):
+    r = np.random.RandomState(seed)
+    q = jnp.asarray(r.randn(B, H, L, D), dt)
+    k = jnp.asarray(r.randn(B, H, L, D), dt)
+    v = jnp.asarray(r.randn(B, H, L, D), dt)
+    seg = jnp.asarray(
+        (np.arange(L)[None, :] < np.asarray(valid)[:, None]).astype(np.int32))
+    return q, k, v, seg
+
+
+def _valid_mask(seg):
+    # compare only rows whose query is a real token; pad rows are defined
+    # (pad attends pad) but not interesting
+    return np.asarray(seg, bool)[:, None, :, None]
+
+
+@pytest.mark.parametrize("dt,tol", [(jnp.float32, 1e-5),
+                                    (jnp.bfloat16, 5e-2)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fwd_parity(dt, tol, causal):
+    q, k, v, seg = _inputs(dt)
+    scale = 1.0 / q.shape[-1] ** 0.5
+    out = flash_attention(q, k, v, seg, seg, causal, scale, interpret=True)
+    ref = _dense_sdpa(q, k, v, seg, causal, scale)
+    assert out.dtype == q.dtype and out.shape == q.shape
+    d = np.abs(np.asarray(out, np.float32)
+               - np.asarray(ref, np.float32)) * _valid_mask(seg)
+    assert d.max() < tol, f"fwd max diff {d.max()}"
+
+
+@pytest.mark.parametrize("dt,tol", [(jnp.float32, 1e-4),
+                                    (jnp.bfloat16, 1e-1)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grad_parity(dt, tol, causal):
+    q, k, v, seg = _inputs(dt)
+    scale = 1.0 / q.shape[-1] ** 0.5
+    w = jnp.asarray(_valid_mask(seg), jnp.float32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, seg, seg, causal, scale, interpret=True)
+        return jnp.sum(o.astype(jnp.float32) * w * 0.01)
+
+    def loss_dense(q, k, v):
+        o = _dense_sdpa(q, k, v, seg, causal, scale)
+        return jnp.sum(o.astype(jnp.float32) * w * 0.01)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g1, g2):
+        d = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                  - b.astype(jnp.float32))))
+        assert d < tol, f"d{name} max diff {d}"
+
+
+def test_flash_no_segment_ids():
+    """seg=None means full (or pure-causal) attention over every position."""
+    q, k, v, _ = _inputs(jnp.float32)
+    scale = 0.125
+    out = flash_attention(q, k, v, None, None, True, scale, interpret=True)
+    ones = jnp.ones(q.shape[:1] + q.shape[2:3], jnp.int32)
+    ref = _dense_sdpa(q, k, v, ones, True, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_cross_lengths():
+    """Lq != Lk (cross-attention shapes): kv segment ids take K's length."""
+    r = np.random.RandomState(3)
+    B, H, D, Lq, Lk = 2, 2, 64, 128, 256
+    q = jnp.asarray(r.randn(B, H, Lq, D), jnp.float32)
+    k = jnp.asarray(r.randn(B, H, Lk, D), jnp.float32)
+    v = jnp.asarray(r.randn(B, H, Lk, D), jnp.float32)
+    seg_q = jnp.ones((B, Lq), jnp.int32)
+    seg_kv = jnp.asarray(
+        (np.arange(Lk)[None, :] < np.array([180, 256])[:, None])
+        .astype(np.int32))
+    scale = 1.0 / D ** 0.5
+    out = flash_attention(q, k, v, seg_q, seg_kv, False, scale,
+                          interpret=True)
+    # dense oracle with an explicit rectangular mask
+    att = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    mask = seg_q[:, None, :, None] == seg_kv[:, None, None, :]
+    att = jnp.where(mask, att, -1e9)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(att, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_fully_masked_rows_finite():
+    """Rows whose segment id appears nowhere in kv yield 0 output and 0
+    grads — never NaN (the safe_l guard in the kernel's _finish step)."""
+    q, k, v, _ = _inputs(jnp.float32, L=128)
+    seg_q = jnp.ones((2, 128), jnp.int32)       # queries segment 1
+    seg_kv = jnp.zeros((2, 128), jnp.int32)     # keys segment 0 -> no match
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, seg_q, seg_kv, False, 0.125,
+                            interpret=True)
+        return jnp.sum(o)
+
+    out = flash_attention(q, k, v, seg_q, seg_kv, False, 0.125,
+                          interpret=True)
+    assert np.all(np.asarray(out) == 0.0)
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in grads:
+        assert np.all(np.isfinite(np.asarray(g)))
+        assert float(jnp.max(jnp.abs(g))) == 0.0
+
+
+def test_masked_selfatt_flash_eligible_shape():
+    """contrib.masked_selfatt at a flash-eligible shape (L=128, D=64)
+    matches explicit padding-masked attention math; on this CPU platform
+    the platform_dependent picks the dense branch, but the flash gating
+    path (probe + eligibility) is exercised end to end."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.ops import contrib as C
+    L, B, H, D = 128, 2, 2, 64
+    assert C._flash_eligible(L, D)
+    r = np.random.RandomState(5)
+    qkv = (r.randn(L, B, 3 * H * D) * 0.3).astype(np.float32)
+    vl = np.array([100, 128], np.float32)
+    out = mx.nd.contrib.masked_selfatt(mx.nd.array(qkv), mx.nd.array(vl),
+                                       heads=H).asnumpy()
+    x = qkv.reshape(L, B, H, 3, D)
+    q, k, v = (np.transpose(x[:, :, :, i], (1, 2, 0, 3)) for i in range(3))
+    seg = (np.arange(L)[None, :] < vl[:, None]).astype(np.int32)
+    att = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    mask = seg[:, None, :, None] == seg[:, None, None, :]
+    att = np.where(mask, att, -1e9)
+    att = att - att.max(-1, keepdims=True)
+    p = np.exp(att)
+    p /= p.sum(-1, keepdims=True)
+    ref = np.transpose(np.einsum("bhqk,bhkd->bhqd", p, v),
+                       (2, 0, 1, 3)).reshape(L, B, H * D)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_masked_att_qkv_gqa_flash_shape():
+    """masked_att_qkv with GQA groups at a flash-eligible shape."""
+    import mxnet_tpu as mx
+    B, Hq, Hkv, L, D = 2, 4, 2, 128, 64
+    r = np.random.RandomState(9)
+    q = (r.randn(B, Hq, L, D) * 0.3).astype(np.float32)
+    k = (r.randn(B, Hkv, L, D) * 0.3).astype(np.float32)
+    v = (r.randn(B, Hkv, L, D) * 0.3).astype(np.float32)
+    vl = np.array([L, L], np.float32)
+    out = mx.nd.contrib.masked_att_qkv(
+        mx.nd.array(q), mx.nd.array(k), mx.nd.array(v), mx.nd.array(vl),
+        num_kv_groups=Hq // Hkv, causal=True).asnumpy()
+    kk = np.repeat(k, Hq // Hkv, axis=1)
+    vv = np.repeat(v, Hq // Hkv, axis=1)
+    att = np.einsum("bhqd,bhkd->bhqk", q, kk) / np.sqrt(D)
+    cm = np.tril(np.ones((L, L), bool))
+    att = np.where(cm[None, None], att, -1e9)
+    att = att - att.max(-1, keepdims=True)
+    p = np.exp(att)
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, vv)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
