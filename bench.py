@@ -28,6 +28,9 @@ Env knobs:
   MXNET_BENCH_DTYPE       bfloat16 (default) | float32
   MXNET_BENCH_SCAN_STEPS  steps fused per dispatch, default 128
   MXNET_BENCH_DISPATCHES  timed dispatches, default 2
+  MXNET_BENCH_LANES       all (default) = headline + seq-512 + llama-2048
+                          extra lanes in extra.lanes; anything else = just
+                          the headline config
 """
 
 import json
@@ -199,13 +202,89 @@ def run_once(name, batch, seq_len, dtype, scan_steps, dispatches):
     }
 
 
+def run_llama_once(batch, seq_len, dtype, scan_steps, dispatches):
+    """Long-sequence causal-LM lane (VERDICT r3 item 2): a 4-layer llama
+    (units 512, D=64 heads) at seq >= 2048, where dense O(L^2) attention
+    would blow the arithmetic budget — this lane runs the in-house Pallas
+    flash path end to end and must not OOM."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.gluon.model_zoo.llama import LlamaModel
+
+    vocab = 8192   # bench vocab: keeps the LM head from dominating flops
+    layers, units, hidden, heads = 4, 512, 1376, 8
+    mx.random.seed(0)
+    np.random.seed(0)
+    model = LlamaModel(vocab_size=vocab, num_layers=layers, units=units,
+                       hidden=hidden, heads=heads, kv_heads=heads // 2)
+    model.initialize(mx.initializer.Normal(0.02))
+    if dtype == "bfloat16":
+        import jax
+        jax.config.update("jax_default_matmul_precision", "default")
+        import ml_dtypes
+        model.cast(ml_dtypes.bfloat16)
+
+    def loss_fn(out, labels):
+        return mx.nd.softmax_cross_entropy(
+            out.reshape((-1, out.shape[-1])).astype("float32"),
+            labels.reshape((-1,))) / labels.size
+
+    mesh = parallel.make_mesh()
+    opt = mx.optimizer.Adam(learning_rate=1e-4,
+                            multi_precision=(dtype == "bfloat16"))
+    step = parallel.TrainStep(model, loss_fn, opt, mesh=mesh)
+
+    def mk_batches(seed):
+        r = np.random.RandomState(seed)
+        toks = r.randint(0, vocab, (scan_steps, batch, seq_len)) \
+            .astype(np.int32)
+        labs = r.randint(0, vocab, (scan_steps, batch, seq_len)) \
+            .astype(np.int32)
+        return nd.array(toks), nd.array(labs)
+
+    warm_t, warm_l = mk_batches(0)
+    losses = step.run(warm_t, warm_l)
+    float(np.asarray(losses.asnumpy()[-1]))
+
+    batches = [mk_batches(i + 1) for i in range(dispatches)]
+    t0 = time.perf_counter()
+    for t, l in batches:
+        losses = step.run(t, l)
+    last_loss = float(np.asarray(losses.asnumpy()[-1], np.float64))
+    dt = time.perf_counter() - t0
+
+    n_steps = scan_steps * dispatches
+    samples_per_sec = batch * n_steps / dt
+    n_matmul = 0
+    for pname, p in model.collect_params().items():
+        if p.shape is None or "tok_" in pname:
+            continue  # embedding gather excluded (PaLM MFU convention)
+        n_matmul += int(np.prod(p.shape))
+    # causal attention does half the pair work: 6*l*C*S instead of 12
+    flops_per_token = 6 * n_matmul + 6 * layers * units * seq_len
+    mfu = samples_per_sec * seq_len * flops_per_token / _peak_flops(dtype)
+    return {
+        "metric": "llama4L512_train_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 3),
+        "unit": "samples/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {"mfu": round(mfu, 4), "dtype": dtype, "batch": batch,
+                  "seq_len": seq_len, "scan_steps": scan_steps,
+                  "step_ms": round(1000 * dt / n_steps, 2),
+                  "loss": last_loss},
+    }
+
+
 def main():
-    # Pin the dense attention path unless the caller opts in: the Pallas
-    # kernels currently fail the axon remote-compile helper's Mosaic
-    # toolchain (probing costs minutes of failed remote compiles), and the
-    # measured dense-path MFU (0.51) already beats the 0.45 target.
-    fused_pinned = "MXNET_FUSED_ATTENTION" in os.environ  # explicit opt-in
-    os.environ.setdefault("MXNET_FUSED_ATTENTION", "0")
+    # The fused (in-house Pallas flash) attention path is the default as
+    # of r4 — the kernel compiles on this toolchain (the x64 index-map and
+    # bool-transpose Mosaic blockers are fixed) and the one-time probe in
+    # ops/contrib.py still falls back to dense on toolchains that reject
+    # it.  A bench-level retry additionally re-pins dense on any failure.
+    fused_pinned = "MXNET_FUSED_ATTENTION" in os.environ
+    global _FUSED_PINNED_BY_CALLER
+    _FUSED_PINNED_BY_CALLER = fused_pinned
+    os.environ.setdefault("MXNET_FUSED_ATTENTION", "1")
     name = os.environ.get("MXNET_BENCH_MODEL", "bert_12_768_12")
     # batch 64 / scan 64 is the measured sweet spot on the v5e chip
     # (0.51 MFU vs 0.44 at batch 128/scan 16 — smaller batch keeps the
@@ -216,16 +295,21 @@ def main():
     scan_steps = int(os.environ.get("MXNET_BENCH_SCAN_STEPS", "128"))
     dispatches = int(os.environ.get("MXNET_BENCH_DISPATCHES", "2"))
 
-    vision = not name.startswith("bert")
+    llama_lane = name == "llama_longseq"
+    vision = not name.startswith("bert") and not llama_lane
 
     # (batch, note) ladder: same config twice (transient tunnel flakes),
     # then halved batch (memory/oversize fallback)
     attempts = [(batch, None), (batch, "retry"),
                 (max(batch // 2, 1), "half-batch")]
     last_err = None
+    result = None
     for i, (b, note) in enumerate(attempts):
         try:
-            if vision:
+            if llama_lane:
+                result = run_llama_once(b, seq_len, dtype, scan_steps,
+                                        dispatches)
+            elif vision:
                 result = run_vision_once(name, b, dtype, scan_steps,
                                          dispatches)
             else:
@@ -233,8 +317,7 @@ def main():
                                   dispatches)
             if note:
                 result["extra"]["note"] = note
-            print(json.dumps(result))
-            return 0
+            break
         except Exception as e:  # noqa: BLE001 — must survive infra flakes
             last_err = e
             traceback.print_exc(file=sys.stderr)
@@ -247,13 +330,71 @@ def main():
                 os.environ["MXNET_FUSED_ATTENTION"] = "0"
             if i + 1 < len(attempts):
                 time.sleep(5 * (i + 1))
-    kind = "images" if vision else "samples"
-    print(json.dumps({
-        "metric": f"{name}_train_{kind}_per_sec_per_chip",
-        "value": 0.0, "unit": f"{kind}/s", "vs_baseline": 0.0,
-        "extra": {"error": f"{type(last_err).__name__}: {last_err}"[:300]},
-    }))
-    return 1
+    if result is None:
+        kind = "images" if vision else "samples"
+        print(json.dumps({
+            "metric": f"{name}_train_{kind}_per_sec_per_chip",
+            "value": 0.0, "unit": f"{kind}/s", "vs_baseline": 0.0,
+            "extra": {"error": f"{type(last_err).__name__}: {last_err}"[:300]},
+        }))
+        return 1
+
+    # extra lanes (VERDICT r3 item 2): the hard regimes — BERT at the
+    # phase-2 seq 512, and a long-sequence (2048) causal llama that only
+    # exists because the flash path is O(L) in memory.  Each lane runs in
+    # a SUBPROCESS with a hard timeout: a hung remote-compile tunnel call
+    # (observed in the wild) must never wedge the whole bench; failures
+    # record an error note instead of zeroing the headline metric.
+    if os.environ.get("MXNET_BENCH_LANES", "all") == "all" and not vision:
+        lanes = []
+        for label, envs in [
+            ("bert_seq512", {"MXNET_BENCH_SEQLEN": "512",
+                             "MXNET_BENCH_BATCH": "32",
+                             "MXNET_BENCH_SCAN_STEPS": "32"}),
+            ("llama_seq2048", {"MXNET_BENCH_MODEL": "llama_longseq",
+                               "MXNET_BENCH_SEQLEN": "2048",
+                               "MXNET_BENCH_BATCH": "4",
+                               "MXNET_BENCH_SCAN_STEPS": "16"}),
+        ]:
+            try:
+                r = _lane_subprocess(envs)
+                r["lane"] = label
+                lanes.append(r)
+            except Exception as e:  # noqa: BLE001
+                traceback.print_exc(file=sys.stderr)
+                lanes.append({"lane": label,
+                              "error": f"{type(e).__name__}: {e}"[:200]})
+        result["extra"]["lanes"] = lanes
+
+    print(json.dumps(result))
+    return 0
+
+
+_FUSED_PINNED_BY_CALLER = False
+
+
+def _lane_subprocess(env_overrides, timeout=1500):
+    """Run one bench lane as `python bench.py` with env overrides and a
+    hard wall-clock cap; returns its parsed JSON line."""
+    import subprocess
+    env = dict(os.environ)
+    if not _FUSED_PINNED_BY_CALLER:
+        # our own setdefault (or a headline retry's dense re-pin) must not
+        # leak into the child as a caller pin — the lane needs its own
+        # fused default AND a working dense-fallback retry ladder
+        env.pop("MXNET_FUSED_ATTENTION", None)
+    env.update(env_overrides)
+    env["MXNET_BENCH_LANES"] = "headline"   # no recursive lane fan-out
+    p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=env)
+    lines = [ln for ln in p.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    if not lines:
+        raise RuntimeError(
+            f"lane produced no JSON (rc={p.returncode}): "
+            f"{p.stderr.strip()[-200:]}")
+    return json.loads(lines[-1])
 
 
 if __name__ == "__main__":

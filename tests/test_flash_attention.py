@@ -130,17 +130,18 @@ def test_flash_fully_masked_rows_finite():
 
 
 def test_masked_selfatt_flash_eligible_shape():
-    """contrib.masked_selfatt at a flash-eligible shape (L=128, D=64)
+    """contrib.masked_selfatt at a flash-eligible shape (L=256, D=64)
     matches explicit padding-masked attention math; on this CPU platform
     the platform_dependent picks the dense branch, but the flash gating
     path (probe + eligibility) is exercised end to end."""
     import mxnet_tpu as mx
     from mxnet_tpu.ops import contrib as C
-    L, B, H, D = 128, 2, 2, 64
+    L, B, H, D = 256, 2, 2, 64
     assert C._flash_eligible(L, D)
+    assert not C._flash_eligible(128, D)   # measured floor: dense wins there
     r = np.random.RandomState(5)
     qkv = (r.randn(L, B, 3 * H * D) * 0.3).astype(np.float32)
-    vl = np.array([100, 128], np.float32)
+    vl = np.array([200, 256], np.float32)
     out = mx.nd.contrib.masked_selfatt(mx.nd.array(qkv), mx.nd.array(vl),
                                        heads=H).asnumpy()
     x = qkv.reshape(L, B, H, 3, D)
@@ -160,7 +161,7 @@ def test_masked_selfatt_flash_eligible_shape():
 def test_masked_att_qkv_gqa_flash_shape():
     """masked_att_qkv with GQA groups at a flash-eligible shape."""
     import mxnet_tpu as mx
-    B, Hq, Hkv, L, D = 2, 4, 2, 128, 64
+    B, Hq, Hkv, L, D = 2, 4, 2, 256, 64
     r = np.random.RandomState(9)
     q = (r.randn(B, Hq, L, D) * 0.3).astype(np.float32)
     k = (r.randn(B, Hkv, L, D) * 0.3).astype(np.float32)

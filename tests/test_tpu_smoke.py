@@ -213,7 +213,7 @@ def test_tpu_flash_attention_grad_consistency():
     """Custom-VJP flash backward ≡ dense autodiff backward on the chip,
     causal + GQA via masked_att_qkv (the llama path)."""
     r = np.random.RandomState(22)
-    B, Hq, Hkv, L, D = 2, 4, 2, 128, 64
+    B, Hq, Hkv, L, D = 2, 4, 2, 256, 64   # L >= 256: the flash floor
     qn = (r.randn(B, Hq, L, D) * 0.3).astype(np.float32)
     kn = (r.randn(B, Hkv, L, D) * 0.3).astype(np.float32)
     vn = (r.randn(B, Hkv, L, D) * 0.3).astype(np.float32)
