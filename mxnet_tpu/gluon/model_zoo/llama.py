@@ -73,14 +73,20 @@ def _rope(F, x, base=500000.0):
 
 
 class LlamaBlock(HybridBlock):
-    def __init__(self, units, hidden, heads, kv_heads, **kwargs):
+    def __init__(self, units, hidden, heads, kv_heads, attn_impl="fused",
+                 sp_axis="sp", **kwargs):
         super().__init__(**kwargs)
         if units % heads or heads % kv_heads:
             raise MXNetError("units % heads and heads % kv_heads must be 0")
+        if attn_impl not in ("fused", "ring", "ulysses"):
+            raise MXNetError(
+                f"attn_impl {attn_impl!r}: want fused|ring|ulysses")
         self._units = units
         self._heads = heads
         self._kv = kv_heads
         self._hd = units // heads
+        self._attn_impl = attn_impl
+        self._sp_axis = sp_axis
         with self.name_scope():
             self.q_proj = Dense(units, flatten=False, use_bias=False,
                                 in_units=units, prefix="q_")
@@ -111,12 +117,20 @@ class LlamaBlock(HybridBlock):
             .transpose((0, 2, 1, 3))
         q = _rope(F, q)
         k = _rope(F, k)
-        vl = F.full((B,), L, dtype="int32")
-        # direct q/k/v entry point: no interleave round-trip, and the GQA
-        # kv-head broadcast happens inside the op next to the kernel
-        ctx_vec = F.contrib.masked_att_qkv(
-            q, k, v, vl, num_kv_groups=self._heads // self._kv,
-            causal=True)                                    # (B, H, L, D)
+        if self._attn_impl != "fused":
+            # sequence/context parallelism: ring or Ulysses attention over
+            # the current mesh's sp axis (falls back to local attention
+            # when no mesh is active — same math, so tests run anywhere)
+            ctx_vec = F.contrib.sp_att_qkv(
+                q, k, v, impl=self._attn_impl, axis=self._sp_axis,
+                num_kv_groups=self._heads // self._kv, causal=True)
+        else:
+            vl = F.full((B,), L, dtype="int32")
+            # direct q/k/v entry point: no interleave round-trip; the GQA
+            # kv-head broadcast happens inside the op next to the kernel
+            ctx_vec = F.contrib.masked_att_qkv(
+                q, k, v, vl, num_kv_groups=self._heads // self._kv,
+                causal=True)                                # (B, H, L, D)
         attn = self.o_proj(ctx_vec.transpose((0, 2, 1, 3))
                            .reshape((B, L, self._units)))
         x = x + attn
@@ -127,7 +141,8 @@ class LlamaBlock(HybridBlock):
 
 class LlamaModel(HybridBlock):
     def __init__(self, vocab_size=128256, num_layers=2, units=64,
-                 hidden=172, heads=4, kv_heads=2, **kwargs):
+                 hidden=172, heads=4, kv_heads=2, attn_impl="fused",
+                 sp_axis="sp", **kwargs):
         super().__init__(**kwargs)
         self._units = units
         with self.name_scope():
@@ -135,6 +150,7 @@ class LlamaModel(HybridBlock):
             self.blocks = []
             for i in range(num_layers):
                 blk = LlamaBlock(units, hidden, heads, kv_heads,
+                                 attn_impl=attn_impl, sp_axis=sp_axis,
                                  prefix=f"layer{i}_")
                 self.register_child(blk, f"layer{i}")
                 self.blocks.append(blk)

@@ -83,7 +83,9 @@ def ring_attention(q, k, v, axis_name, seg_q=None, seg_kv=None,
     if seg_q is None:
         seg_q = jnp.zeros((B, Lb), jnp.int32)
     if seg_kv is None:
-        seg_kv = jnp.zeros((B, Lb), jnp.int32)
+        # K's block length, not Q's (they differ if K/V ever carry a
+        # different per-device sequence block than Q)
+        seg_kv = jnp.zeros((k.shape[0], k.shape[2]), jnp.int32)
     perm = [(i, (i + 1) % n) for i in range(n)]  # rotate kv to the right
 
     acc = jnp.zeros((B, H, Lb, D), jnp.float32)
@@ -141,7 +143,8 @@ def sequence_parallel_attention(q, k, v, mesh, axis="sp", seg_q=None,
         if seg_q is None:
             seg_q = jnp.zeros((q.shape[0], q.shape[2]), jnp.int32)
         if seg_kv is None:
-            seg_kv = jnp.zeros((q.shape[0], q.shape[2]), jnp.int32)
+            # K's length, not Q's: the sides differ in cross-attention
+            seg_kv = jnp.zeros((k.shape[0], k.shape[2]), jnp.int32)
 
     def local(qb, kb, vb, *segs):
         sq, skv = (segs if has_seg else (None, None))
@@ -151,5 +154,14 @@ def sequence_parallel_attention(q, k, v, mesh, axis="sp", seg_q=None,
     in_specs = (spec_x, spec_x, spec_x) + ((spec_s, spec_s) if has_seg
                                            else ())
     fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=spec_x)
-    args = (q, k, v) + ((seg_q, seg_kv) if has_seg else ())
+    # reshard inputs onto the mesh first: when this runs EAGERLY (e.g. a
+    # TrainStep tape-capture pass) the operands arrive committed to a
+    # single device and shard_map would reject them; under a jit trace
+    # device_put lowers to a sharding constraint instead
+    import jax as _jax
+    shx = _jax.sharding.NamedSharding(mesh, spec_x)
+    shs = _jax.sharding.NamedSharding(mesh, spec_s)
+    q, k, v = (_jax.device_put(x, shx) for x in (q, k, v))
+    args = (q, k, v) + ((_jax.device_put(seg_q, shs),
+                         _jax.device_put(seg_kv, shs)) if has_seg else ())
     return fn(*args)

@@ -113,4 +113,10 @@ def ulysses_sequence_parallel_attention(q, k, v, mesh, axis="sp",
             body, mesh=raw_mesh, in_specs=(spec, spec, spec),
             out_specs=spec))
         _jit_cache[key] = f
+    # reshard first: eager callers (TrainStep tape capture) hand over
+    # single-device-committed arrays the shard_map would reject; under a
+    # jit trace this is just a sharding constraint
+    sh = jax.sharding.NamedSharding(
+        raw_mesh, jax.sharding.PartitionSpec(None, None, axis, None))
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
     return f(q, k, v)

@@ -103,3 +103,57 @@ def test_tp_dp_mesh_train_step(seeded):
     losses = [float(step(toks, toks).asnumpy()) for _ in range(3)]
     assert losses[-1] < losses[0]
     assert all(np.isfinite(l) for l in losses)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sp_attention_train_step_parity(impl, seeded):
+    """Sequence-parallel llama (contrib.sp_att_qkv over a dp×sp mesh)
+    reproduces the dense-attention train-step loss exactly — the dryrun
+    'sp' lane as a pytest (VERDICT r3 item 4)."""
+    from mxnet_tpu import nd
+    vocab, seq = 64, 16
+    mesh = parallel.DeviceMesh(shape=(2, 4), axis_names=("dp", "sp"))
+    r = np.random.RandomState(7)
+    toks = r.randint(0, vocab, (4, seq)).astype("int32")
+    labs = np.roll(toks, -1, axis=1).astype("int32")
+
+    def loss_fn(o, l):
+        return mx.nd.softmax_cross_entropy(
+            o.reshape((-1, o.shape[-1])), l.reshape((-1,))) / l.size
+
+    losses = {}
+    prev = parallel.current_mesh()
+    try:
+        for cur_impl, m in (("fused", None), (impl, mesh)):
+            parallel.set_mesh(m)
+            mx.random.seed(11)
+            net = llama.llama_model("llama_tiny", vocab_size=vocab,
+                                    attn_impl=cur_impl)
+            net.initialize(mx.initializer.Normal(0.05))
+            step = parallel.TrainStep(
+                net, loss_fn, mx.optimizer.Adam(learning_rate=1e-3),
+                mesh=mesh, donate=False)
+            losses[cur_impl] = float(step(
+                nd.array(toks, dtype="int32"),
+                nd.array(labs, dtype="int32")).asscalar())
+    finally:
+        parallel.set_mesh(prev)
+    assert np.isfinite(losses[impl])
+    np.testing.assert_allclose(losses[impl], losses["fused"], rtol=2e-4)
+
+
+def test_sp_att_qkv_no_mesh_fallback(seeded):
+    """Without an active mesh the sp op degrades to local attention and
+    matches masked_att_qkv (full valid_length, causal)."""
+    r = np.random.RandomState(3)
+    B, H, L, D = 2, 4, 16, 8
+    q = mx.nd.array(r.randn(B, H, L, D).astype("float32"))
+    k = mx.nd.array(r.randn(B, H // 2, L, D).astype("float32"))
+    v = mx.nd.array(r.randn(B, H // 2, L, D).astype("float32"))
+    out_sp = mx.nd.contrib.sp_att_qkv(q, k, v, impl="ring", axis="sp",
+                                      num_kv_groups=2, causal=True)
+    vl = mx.nd.array(np.full((B,), L, np.float32))
+    out_ref = mx.nd.contrib.masked_att_qkv(q, k, v, vl, num_kv_groups=2,
+                                           causal=True)
+    np.testing.assert_allclose(out_sp.asnumpy(), out_ref.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
