@@ -14,13 +14,16 @@ host, so this launcher:
    Each worker gets MXNET_DIST_COORDINATOR / MXNET_DIST_RANK /
    MXNET_DIST_NUM_WORKERS (read by ``kvstore.create('dist_tpu_sync')``)
    plus JAX CPU-platform vars so a laptop run uses N virtual CPU workers.
- - ``--launcher ssh``: print the per-host commands (TPU pods normally come
-   up via the cloud runtime which IS the launcher; we document instead of
-   reimplementing ssh fan-out — each pod host runs the same command and
-   jax.distributed handles rendezvous).
+ - ``--launcher ssh``: real ssh fan-out (the dmlc_tracker/ssh.py role):
+   one worker per hostfile line (round-robin if -n exceeds the host
+   count), rank/coordinator env inlined into the remote command, all
+   workers awaited with the same straggler-kill policy as local mode.
+   ``--dry-run`` prints the exact ssh commands instead of running them
+   (useful on pods where the cloud runtime is the launcher).
 
 Usage:
   python tools/launch.py -n 2 python train.py --kv-store dist_tpu_sync
+  python tools/launch.py -n 4 --launcher ssh -H hosts.txt python train.py
 """
 
 from __future__ import annotations
@@ -40,31 +43,12 @@ def _free_port():
     return port
 
 
-def launch_local(n, command, env_extra=None, cpu_devices_per_worker=None,
-                 timeout=600):
-    """Spawn n local worker processes; returns their exit codes.
-
-    One hung worker must not hang the launch: after ``timeout`` seconds
-    (or once any worker fails, after a short grace) stragglers are killed
-    and reported with code -9."""
-    port = _free_port()
-    coord = f"127.0.0.1:{port}"
-    procs = []
-    for rank in range(n):
-        env = dict(os.environ)
-        env.update(env_extra or {})
-        env["MXNET_DIST_COORDINATOR"] = coord
-        env["MXNET_DIST_NUM_WORKERS"] = str(n)
-        env["MXNET_DIST_RANK"] = str(rank)
-        if cpu_devices_per_worker:
-            env["JAX_PLATFORMS"] = "cpu"
-            flags = env.get("XLA_FLAGS", "")
-            env["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count="
-                f"{cpu_devices_per_worker}").strip()
-        procs.append(subprocess.Popen(command, env=env))
-    codes = [None] * n
+def _await_workers(procs, timeout):
+    """Wait for workers; one hung worker must not hang the launch: after
+    ``timeout`` seconds (or once any worker fails, after a short grace)
+    stragglers are killed and reported with code -9."""
     import time as _time
+    codes = [None] * len(procs)
     deadline = _time.time() + timeout
     while any(c is None for c in codes):
         for i, p in enumerate(procs):
@@ -90,6 +74,66 @@ def launch_local(n, command, env_extra=None, cpu_devices_per_worker=None,
     return codes
 
 
+def launch_local(n, command, env_extra=None, cpu_devices_per_worker=None,
+                 timeout=600):
+    """Spawn n local worker processes; returns their exit codes."""
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env["MXNET_DIST_COORDINATOR"] = coord
+        env["MXNET_DIST_NUM_WORKERS"] = str(n)
+        env["MXNET_DIST_RANK"] = str(rank)
+        if cpu_devices_per_worker:
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{cpu_devices_per_worker}").strip()
+        procs.append(subprocess.Popen(command, env=env))
+    return _await_workers(procs, timeout)
+
+
+def build_ssh_commands(n, hosts, command, port=29400, env_extra=None,
+                       ssh_opts=()):
+    """Per-rank ``ssh`` argv lists (dmlc_tracker/ssh.py role): rank r runs
+    on hosts[r % len(hosts)]; the coordinator is hosts[0]:port; env rides
+    inline `env K=V ...` so no remote shell profile is required."""
+    import shlex
+    if not hosts:
+        raise ValueError("ssh launcher needs a hostfile with >= 1 host")
+    coord = f"{hosts[0]}:{port}"
+    cmds = []
+    for rank in range(n):
+        host = hosts[rank % len(hosts)]
+        envs = {"MXNET_DIST_COORDINATOR": coord,
+                "MXNET_DIST_NUM_WORKERS": str(n),
+                "MXNET_DIST_RANK": str(rank)}
+        envs.update(env_extra or {})
+        remote = "env " + " ".join(
+            f"{k}={shlex.quote(v)}" for k, v in sorted(envs.items()))
+        remote += " " + " ".join(shlex.quote(c) for c in command)
+        cmds.append(["ssh", "-o", "StrictHostKeyChecking=no",
+                     *ssh_opts, host, remote])
+    return cmds
+
+
+def launch_ssh(n, hosts, command, port=29400, env_extra=None,
+               timeout=600, dry_run=False):
+    """ssh fan-out: spawn one remote worker per rank and await them with
+    the same straggler-kill policy as local mode."""
+    cmds = build_ssh_commands(n, hosts, command, port=port,
+                              env_extra=env_extra)
+    if dry_run:
+        for c in cmds:
+            print(" ".join(c))
+        return [0] * n
+    procs = [subprocess.Popen(c) for c in cmds]
+    return _await_workers(procs, timeout)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="launch multi-process mxnet_tpu training "
@@ -103,6 +147,12 @@ def main(argv=None):
     ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
     ap.add_argument("-H", "--hostfile", default=None,
                     help="hostfile (one host per line) for --launcher ssh")
+    ap.add_argument("-p", "--port", type=int, default=29400,
+                    help="coordinator port (ssh launcher)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the ssh commands instead of running them")
+    ap.add_argument("--timeout", type=int, default=600,
+                    help="seconds before stragglers are killed")
     ap.add_argument("--cpu-devices", type=int, default=None,
                     help="force each worker onto N virtual CPU devices "
                          "(testing without TPUs)")
@@ -116,25 +166,18 @@ def main(argv=None):
                  "(the optimizer stays on device; SURVEY §7.1)")
 
     if args.launcher == "ssh":
-        hosts = []
-        if args.hostfile:
-            with open(args.hostfile) as f:
-                hosts = [h.strip() for h in f if h.strip()]
-        print("# dist_tpu_sync has no scheduler/server processes; on a TPU "
-              "pod, run the SAME command on every host (the cloud runtime "
-              "sets the coordinator env) — equivalent ssh fan-out:")
-        coord = f"{hosts[0] if hosts else '<host0>'}:29400"
-        for rank, host in enumerate(hosts or
-                                    [f"<host{i}>" for i
-                                     in range(args.num_workers)]):
-            cmd = " ".join(args.command)
-            print(f"ssh {host} MXNET_DIST_COORDINATOR={coord} "
-                  f"MXNET_DIST_NUM_WORKERS={args.num_workers} "
-                  f"MXNET_DIST_RANK={rank} {cmd}")
-        return 0
-
-    codes = launch_local(args.num_workers, args.command,
-                         cpu_devices_per_worker=args.cpu_devices)
+        if not args.hostfile:
+            ap.error("--launcher ssh needs -H/--hostfile")
+        with open(args.hostfile) as f:
+            hosts = [s for s in (h.strip() for h in f)
+                     if s and not s.startswith("#")]
+        codes = launch_ssh(args.num_workers, hosts, args.command,
+                           port=args.port, timeout=args.timeout,
+                           dry_run=args.dry_run)
+    else:
+        codes = launch_local(args.num_workers, args.command,
+                             cpu_devices_per_worker=args.cpu_devices,
+                             timeout=args.timeout)
     bad = [c for c in codes if c != 0]
     if bad:
         print(f"launch: {len(bad)}/{len(codes)} workers failed "
