@@ -377,6 +377,61 @@ class LibSVMIter(DataIter):
         return max(0, end - len(self._rows))
 
 
+def _decode_record(raw, cfg):
+    """Decode + augment one packed image record (pure function so it runs
+    in thread OR process workers — reference ParseChunk body)."""
+    import cv2
+    from .. import recordio as rio
+    header, img_bytes = rio.unpack(raw)
+    img = cv2.imdecode(_np.frombuffer(img_bytes, _np.uint8),
+                       cv2.IMREAD_COLOR)
+    img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    c, h, w = cfg["data_shape"]
+    resize = cfg["resize"]
+    if resize > 0:
+        ih, iw = img.shape[:2]
+        if ih < iw:
+            img = cv2.resize(img, (int(iw * resize / ih), resize))
+        else:
+            img = cv2.resize(img, (resize, int(ih * resize / iw)))
+    ih, iw = img.shape[:2]
+    if ih < h or iw < w:
+        img = cv2.resize(img, (max(w, iw), max(h, ih)))
+        ih, iw = img.shape[:2]
+    if cfg["rand_crop"]:
+        y0 = _np.random.randint(0, ih - h + 1)
+        x0 = _np.random.randint(0, iw - w + 1)
+    else:
+        y0, x0 = (ih - h) // 2, (iw - w) // 2
+    img = img[y0:y0 + h, x0:x0 + w]
+    if cfg["rand_mirror"] and _np.random.rand() < 0.5:
+        img = img[:, ::-1]
+    img = img.astype(_np.float32)
+    img = (img - cfg["mean"]) / cfg["std"]
+    label = header.label if _np.isscalar(header.label) \
+        else _np.asarray(header.label).ravel()[0]
+    return img.transpose(2, 0, 1), _np.float32(label)
+
+
+_DECODE_CFG = None
+
+
+def _decode_worker_init(cfg):
+    global _DECODE_CFG
+    _DECODE_CFG = cfg
+    _np.random.seed((os.getpid() * 2654435761) % (2 ** 31))
+    # decode workers must not oversubscribe: each is single-image work
+    try:
+        import cv2
+        cv2.setNumThreads(1)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _decode_worker(raw):
+    return _decode_record(raw, _DECODE_CFG)
+
+
 class ImageRecordIter(DataIter):
     """reference src/io/iter_image_recordio_2.cc — the ImageNet pipeline:
     RecordIO shards + threaded JPEG decode + augmentation + prefetch.
@@ -390,8 +445,16 @@ class ImageRecordIter(DataIter):
                  rand_crop=False, rand_mirror=False, mean_r=0.0, mean_g=0.0,
                  mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0, resize=-1,
                  part_index=0, num_parts=1, preprocess_threads=4,
-                 label_width=1, path_imgidx=None, **kwargs):  # noqa: ARG002
+                 label_width=1, path_imgidx=None, decoder="threads",
+                 ctx=None, **kwargs):  # noqa: ARG002
         super().__init__(batch_size)
+        if decoder not in ("threads", "processes"):
+            raise MXNetError(f"decoder {decoder!r}: want threads|processes")
+        self._decoder = decoder
+        # ctx=cpu keeps batches host-side (training loops copy/overlap on
+        # their own schedule — the reference iterator also yields CPU
+        # batches); default None = the ambient default device
+        self._ctx = ctx
         from .. import recordio
         self._rec_path = path_imgrec
         idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
@@ -419,7 +482,10 @@ class ImageRecordIter(DataIter):
 
     def close(self):
         if self._pool is not None:
-            self._pool.shutdown(wait=False)
+            if hasattr(self._pool, "shutdown"):
+                self._pool.shutdown(wait=False)
+            else:                       # multiprocessing.Pool
+                self._pool.terminate()
             self._pool = None
 
     def __del__(self):
@@ -445,37 +511,13 @@ class ImageRecordIter(DataIter):
         self._cursor += self.batch_size
         return self._cursor + self.batch_size <= len(self._keys)
 
+    def _cfg(self):
+        return {"data_shape": self.data_shape, "resize": self.resize,
+                "rand_crop": self.rand_crop, "rand_mirror": self.rand_mirror,
+                "mean": self.mean, "std": self.std}
+
     def _decode_one(self, raw):
-        import cv2
-        from .. import recordio as rio
-        header, img_bytes = rio.unpack(raw)
-        img = cv2.imdecode(_np.frombuffer(img_bytes, _np.uint8),
-                           cv2.IMREAD_COLOR)
-        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
-        c, h, w = self.data_shape
-        if self.resize > 0:
-            ih, iw = img.shape[:2]
-            if ih < iw:
-                img = cv2.resize(img, (int(iw * self.resize / ih), self.resize))
-            else:
-                img = cv2.resize(img, (self.resize, int(ih * self.resize / iw)))
-        ih, iw = img.shape[:2]
-        if ih < h or iw < w:
-            img = cv2.resize(img, (max(w, iw), max(h, ih)))
-            ih, iw = img.shape[:2]
-        if self.rand_crop:
-            y0 = _np.random.randint(0, ih - h + 1)
-            x0 = _np.random.randint(0, iw - w + 1)
-        else:
-            y0, x0 = (ih - h) // 2, (iw - w) // 2
-        img = img[y0:y0 + h, x0:x0 + w]
-        if self.rand_mirror and _np.random.rand() < 0.5:
-            img = img[:, ::-1]
-        img = img.astype(_np.float32)
-        img = (img - self.mean) / self.std
-        label = header.label if _np.isscalar(header.label) \
-            else _np.asarray(header.label).ravel()[0]
-        return img.transpose(2, 0, 1), _np.float32(label)
+        return _decode_record(raw, self._cfg())
 
     def next(self):
         if not self.iter_next():
@@ -488,14 +530,37 @@ class ImageRecordIter(DataIter):
         if self._threads > 1:
             if self._pool is None:
                 # one pool for the iterator's lifetime — spawning/joining
-                # worker threads per batch would tax the decode hot path
-                from concurrent.futures import ThreadPoolExecutor
-                self._pool = ThreadPoolExecutor(self._threads)
-            results = list(self._pool.map(self._decode_one, raws))
+                # workers per batch would tax the decode hot path.
+                # 'threads' relies on cv2 releasing the GIL in imdecode;
+                # 'processes' sidesteps the GIL entirely for the numpy
+                # normalize/transpose tail (the reference's decode THREAD
+                # pool has no GIL to fight — iter_image_recordio_2.cc)
+                if self._decoder == "processes":
+                    import multiprocessing as mp
+                    # NOT fork: by first next() the parent usually has live
+                    # JAX/XLA runtime threads, and fork with threads can
+                    # copy held mutexes into the child (deadlocked decode
+                    # workers).  forkserver forks from a clean helper;
+                    # spawn is the portable fallback.  cfg is picklable.
+                    try:
+                        ctx = mp.get_context("forkserver")
+                    except ValueError:
+                        ctx = mp.get_context("spawn")
+                    self._pool = ctx.Pool(
+                        self._threads, initializer=_decode_worker_init,
+                        initargs=(self._cfg(),))
+                else:
+                    from concurrent.futures import ThreadPoolExecutor
+                    self._pool = ThreadPoolExecutor(self._threads)
+            if self._decoder == "processes":
+                results = self._pool.map(_decode_worker, raws)
+            else:
+                results = list(self._pool.map(self._decode_one, raws))
         else:
             results = [self._decode_one(r) for r in raws]
         imgs = _np.stack([r[0] for r in results])
         labels = _np.asarray([r[1] for r in results], _np.float32)
-        return DataBatch([nd.array(imgs)], [nd.array(labels)], pad=0)
+        return DataBatch([nd.array(imgs, ctx=self._ctx)],
+                         [nd.array(labels, ctx=self._ctx)], pad=0)
 
     __next__ = next

@@ -129,3 +129,37 @@ def test_image_record_iter_bulk_path(tmp_path):
         batches += 1
     assert batches == n // 4
     assert sorted(set(seen_labels)) == [0.0, 1.0, 2.0]
+
+
+def test_image_record_iter_process_decoder(tmp_path):
+    """decoder='processes' (multiprocess decode pool — the reference's
+    decode-worker role without the GIL) yields the same deterministic
+    batches as in-process decode (no augmentation => exact match)."""
+    import cv2
+    rec_path = os.path.join(str(tmp_path), "imgp.rec")
+    idx_path = os.path.join(str(tmp_path), "imgp.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    r = np.random.RandomState(5)
+    for i in range(8):
+        img = (r.rand(12, 12, 3) * 255).astype(np.uint8)
+        ok, buf = cv2.imencode(".png", img)
+        assert ok
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), buf.tobytes()))
+    w.close()
+
+    def collect(decoder, threads):
+        it = mx.io.ImageRecordIter(
+            path_imgrec=rec_path, path_imgidx=idx_path,
+            data_shape=(3, 8, 8), batch_size=4, decoder=decoder,
+            preprocess_threads=threads, ctx=mx.cpu())
+        out = [(b.data[0].asnumpy(), b.label[0].asnumpy()) for b in it]
+        it.close()
+        return out
+
+    ref = collect("threads", 1)
+    got = collect("processes", 2)
+    assert len(ref) == len(got) == 2
+    for (rd, rl), (gd, gl) in zip(ref, got):
+        np.testing.assert_allclose(gd, rd)
+        np.testing.assert_allclose(gl, rl)
