@@ -274,15 +274,18 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
         # and its cached trace would go stale once children are swapped
         _deactivate_cached_ops(net)
         for _, _, path, child in targets:
-            child.register_forward_pre_hook(make_hook(path))
-            hooks.append(child)
+            hook = make_hook(path)
+            child.register_forward_pre_hook(hook)
+            hooks.append((child, hook))
         try:
             for batch in calib_data:
                 net(batch if isinstance(batch, nd.NDArray)
                     else nd.array(batch))
         finally:
-            for child in hooks:
-                child._forward_pre_hooks.pop()
+            # remove OUR hook by identity — pop() would strip whatever
+            # hook happens to be last (possibly a user's)
+            for child, hook in hooks:
+                child._forward_pre_hooks.remove(hook)
         for _, _, path, _ in targets:
             st = stats[path]
             if st is None:

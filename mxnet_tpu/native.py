@@ -164,9 +164,11 @@ def index_recordio(path):
         l = _np.ctypeslib.as_array(lens, shape=(n,)).copy() if n else \
             _np.empty((0,), _np.uint64)
     finally:
-        if n:
-            lib.rio_free(offs)
-            lib.rio_free(lens)
+        # rio_index mallocs unconditionally (malloc(0) may return non-null),
+        # so free unconditionally — an n == 0 guard leaks two allocations
+        # per empty-file scan
+        lib.rio_free(offs)
+        lib.rio_free(lens)
     return o, l
 
 

@@ -112,7 +112,17 @@ def gpipe(stage_fn, n_stages, n_microbatches, mesh, axis="pp",
     stage_spec = P(axis)
     act_spec = P(data_axis) if data_axis else P()
 
+    dp = mesh.axis_size(data_axis) if data_axis else 1
+
     def wrapped(params_stacked, x):
+        # validate up front: a non-divisible batch otherwise fails deep
+        # inside shard_map with an opaque jax reshape error
+        bglobal = x.shape[0]
+        if bglobal % dp != 0 or (bglobal // dp) % M != 0:
+            raise MXNetError(
+                f"gpipe: batch {bglobal} (/{dp} data-parallel shards -> "
+                f"{bglobal // dp if bglobal % dp == 0 else bglobal}/shard) "
+                f"must be divisible by n_microbatches={M}")
         in_specs = (jax.tree_util.tree_map(lambda _: stage_spec,
                                            params_stacked), act_spec)
         f = shard_map(schedule, mesh=mesh.mesh, in_specs=in_specs,
