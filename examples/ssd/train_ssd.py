@@ -47,7 +47,38 @@ def synth_batch(rng, batch, size=32):
     return imgs, labels
 
 
-def run(batch=32, steps=60, lr=0.1, size=32, log=True, seed=0):
+def make_det_records(prefix, n=128, size=32, seed=0):
+    """Pack the same synthetic shapes as real detection records: PNG bytes
+    + [A=4, B=5, 0, 0, cls, x0, y0, x1, y1] packed labels — the im2rec
+    --pack-label format ImageDetIter consumes (reference ImageDetRecordIter
+    input)."""
+    import cv2
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rng = np.random.RandomState(seed)
+    for i in range(n):
+        img = np.zeros((size, size, 3), np.uint8)
+        cls = rng.randint(0, 2)
+        w = rng.randint(8, 16)
+        x0 = rng.randint(0, size - w)
+        y0 = rng.randint(0, size - w)
+        if cls == 0:
+            img[y0:y0 + w, x0:x0 + w] = 255
+        else:
+            cv2.circle(img, (x0 + w // 2, y0 + w // 2), w // 2,
+                       (255, 255, 255), -1)
+        label = np.array([4, 5, 0, 0, cls, x0 / size, y0 / size,
+                          (x0 + w) / size, (y0 + w) / size], np.float32)
+        ok, buf = cv2.imencode(".png", img)
+        assert ok
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, label, i, 0), buf.tobytes()))
+    rec.close()
+    return prefix + ".rec"
+
+
+def run(batch=32, steps=60, lr=0.1, size=32, log=True, seed=0,
+        from_records=None):
     import mxnet_tpu as mx
     from mxnet_tpu import autograd, gluon
     from mxnet_tpu.gluon import nn
@@ -90,12 +121,31 @@ def run(batch=32, steps=60, lr=0.1, size=32, log=True, seed=0):
     ce = gluon.loss.SoftmaxCrossEntropyLoss()
     rng = np.random.RandomState(seed)
 
+    if from_records:
+        # real data path: packed records -> ImageDetIter (decode + det
+        # augmenters + -1-padded (B, max_objs, 5) labels)
+        det_iter = mx.image.ImageDetIter(
+            batch, (3, size, size), path_imgrec=from_records,
+            shuffle=True, rand_mirror=True,
+            mean=[0, 0, 0], std=[255, 255, 255])
+
+        def next_batch():
+            nonlocal det_iter
+            try:
+                b = next(det_iter)
+            except StopIteration:
+                det_iter.reset()
+                b = next(det_iter)
+            return b.data[0], b.label[0]
+    else:
+        def next_batch():
+            imgs, labels = synth_batch(rng, batch, size)
+            return mx.nd.array(imgs), mx.nd.array(labels)
+
     losses = []
     t0 = time.time()
     for step in range(steps):
-        imgs, labels = synth_batch(rng, batch, size)
-        x = mx.nd.array(imgs)
-        y = mx.nd.array(labels)
+        x, y = next_batch()
         with autograd.record():
             anchors, cls_pred, box_pred = net(x)
             with autograd.pause():
@@ -120,14 +170,18 @@ def run(batch=32, steps=60, lr=0.1, size=32, log=True, seed=0):
         losses.append(float(loss.asnumpy()))
 
     # eval: decode + NMS on a fresh batch, report mean IoU of top detection
-    imgs, labels = synth_batch(rng, 16, size)
+    if from_records:
+        xe, ye = next_batch()
+        imgs, labels = xe.asnumpy()[:16], ye.asnumpy()[:16]
+    else:
+        imgs, labels = synth_batch(rng, 16, size)
     anchors, cls_pred, box_pred = net(mx.nd.array(imgs))
     probs = mx.nd.softmax(cls_pred, axis=-1)
     det = mx.nd.contrib.MultiBoxDetection(
         mx.nd.transpose(probs, axes=(0, 2, 1)), box_pred, anchors,
         nms_threshold=0.45, threshold=0.05).asnumpy()
     ious = []
-    for i in range(16):
+    for i in range(len(imgs)):
         top = det[i, 0]
         if top[0] < 0:
             ious.append(0.0)
@@ -152,8 +206,17 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--from-records", action="store_true",
+                   help="pack synthetic shapes into .rec and train via "
+                        "ImageDetIter instead of in-memory arrays")
     a = p.parse_args()
-    run(batch=a.batch, steps=a.steps)
+    if a.from_records:
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            rec = make_det_records(os.path.join(td, "shapes"))
+            run(batch=a.batch, steps=a.steps, from_records=rec)
+    else:
+        run(batch=a.batch, steps=a.steps)
 
 
 if __name__ == "__main__":

@@ -21,7 +21,11 @@ __all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "random_size_crop", "color_normalize",
            "HorizontalFlipAug", "CastAug", "ColorNormalizeAug", "ResizeAug",
            "ForceResizeAug", "RandomCropAug", "CenterCropAug", "CreateAugmenter",
-           "ImageIter"]
+           "ImageIter",
+           # detection pipeline (reference image/detection.py)
+           "DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateDetAugmenter", "ImageDetIter"]
 
 
 def _cv2():
@@ -256,7 +260,11 @@ class ImageIter:
                 with open(path_imglist) as fin:
                     for line in fin:
                         parts = line.strip().split("\t")
-                        label = _np.asarray(parts[1:1 + label_width],
+                        # label_width=-1: take EVERY middle column (the
+                        # packed variable-width detection format)
+                        stop = len(parts) - 1 if label_width < 0 \
+                            else 1 + label_width
+                        label = _np.asarray(parts[1:stop],
                                             dtype=_np.float32)
                         self.imglist.append(
                             (label, os.path.join(path_root, parts[-1])))
@@ -309,6 +317,309 @@ class ImageIter:
         return DataBatch([nd.array(batch_data)],
                          [nd.array(batch_label.squeeze(-1)
                                    if self.label_width == 1 else batch_label)],
+                         pad=0)
+
+    next = __next__
+
+
+# ---------------------------------------------------------------------------
+# Detection data pipeline (reference python/mxnet/image/detection.py +
+# src/io ImageDetRecordIter — SURVEY N19/P15).  Labels are object lists
+# [cls, xmin, ymin, xmax, ymax] with coordinates normalized to [0, 1];
+# the packed header format is [A, B, <A-2 extras>, obj0..objN] where A is
+# the header width and B the per-object width (im2rec --pack-label).
+# ---------------------------------------------------------------------------
+
+
+class DetAugmenter:
+    """Detection augmenter: __call__(src, label) -> (src, label) where
+    label is an (N, B>=5) float array of [cls, x0, y0, x1, y1, ...]."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only Augmenter (color, cast, resize on normalized
+    boxes) — geometry-free transforms never touch the label."""
+
+    def __init__(self, augmenter):
+        super().__init__(augmenter=augmenter.__class__.__name__)
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Apply a sub-chain with probability p (reference detection.py ::
+    DetRandomSelectAug — how rand_crop/rand_pad become probabilities)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if _np.random.rand() >= self.skip_prob:
+            for aug in self.aug_list:
+                src, label = aug(src, label)
+        return src, label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image + boxes with probability p."""
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if _np.random.rand() < self.p:
+            src = src[:, ::-1]
+            label = label.copy()
+            x0 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x0
+        return src, label
+
+
+def _box_coverage(boxes, crop):
+    """Fraction of each box's area inside crop (both normalized corner
+    format (x0, y0, x1, y1))."""
+    ix0 = _np.maximum(boxes[:, 0], crop[0])
+    iy0 = _np.maximum(boxes[:, 1], crop[1])
+    ix1 = _np.minimum(boxes[:, 2], crop[2])
+    iy1 = _np.minimum(boxes[:, 3], crop[3])
+    inter = _np.maximum(ix1 - ix0, 0) * _np.maximum(iy1 - iy0, 0)
+    area = _np.maximum((boxes[:, 2] - boxes[:, 0])
+                       * (boxes[:, 3] - boxes[:, 1]), 1e-12)
+    return inter / area
+
+
+class DetRandomCropAug(DetAugmenter):
+    """SSD-style random crop: sample (area, aspect) crops until one keeps
+    at least one object with coverage >= min_object_covered; objects whose
+    coverage falls below min_eject_coverage are dropped, the rest are
+    clipped and renormalized to the crop (reference detection.py ::
+    DetRandomCropAug)."""
+
+    def __init__(self, min_object_covered=0.3, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.3, 1.0), min_eject_coverage=0.3,
+                 max_attempts=30):
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def _sample_crop(self, label):
+        for _ in range(self.max_attempts):
+            area = _np.random.uniform(*self.area_range)
+            ratio = _np.random.uniform(*self.aspect_ratio_range)
+            cw = min(_np.sqrt(area * ratio), 1.0)
+            ch = min(_np.sqrt(area / ratio), 1.0)
+            cx = _np.random.uniform(0, 1 - cw)
+            cy = _np.random.uniform(0, 1 - ch)
+            crop = (cx, cy, cx + cw, cy + ch)
+            if len(label) == 0:
+                return crop
+            cov = _box_coverage(label[:, 1:5], crop)
+            if (cov >= self.min_object_covered).any():
+                return crop
+        return None
+
+    def __call__(self, src, label):
+        crop = self._sample_crop(label)
+        if crop is None:
+            return src, label
+        h, w = src.shape[:2]
+        x0, y0, x1, y1 = crop
+        px0, py0 = int(x0 * w), int(y0 * h)
+        px1, py1 = max(int(x1 * w), px0 + 1), max(int(y1 * h), py0 + 1)
+        src = src[py0:py1, px0:px1]
+        if len(label):
+            cov = _box_coverage(label[:, 1:5], crop)
+            keep = cov >= self.min_eject_coverage
+            label = label[keep].copy()
+            cw, ch = x1 - x0, y1 - y0
+            label[:, 1] = _np.clip((label[:, 1] - x0) / cw, 0, 1)
+            label[:, 2] = _np.clip((label[:, 2] - y0) / ch, 0, 1)
+            label[:, 3] = _np.clip((label[:, 3] - x0) / cw, 0, 1)
+            label[:, 4] = _np.clip((label[:, 4] - y0) / ch, 0, 1)
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Zoom-out: place the image on a larger pad_val canvas and shrink
+    the boxes accordingly (reference detection.py :: DetRandomPadAug)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33), area_range=(1.0, 3.0),
+                 max_attempts=30, pad_val=(127, 127, 127)):
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        for _ in range(self.max_attempts):
+            area = _np.random.uniform(*self.area_range)
+            ratio = _np.random.uniform(*self.aspect_ratio_range)
+            nw = int(w * _np.sqrt(area * ratio))
+            nh = int(h * _np.sqrt(area / ratio))
+            if nw >= w and nh >= h:
+                ox = _np.random.randint(0, nw - w + 1)
+                oy = _np.random.randint(0, nh - h + 1)
+                canvas = _np.full((nh, nw, src.shape[2]),
+                                  _np.asarray(self.pad_val, src.dtype),
+                                  src.dtype)
+                canvas[oy:oy + h, ox:ox + w] = src
+                if len(label):
+                    label = label.copy()
+                    label[:, 1] = (label[:, 1] * w + ox) / nw
+                    label[:, 3] = (label[:, 3] * w + ox) / nw
+                    label[:, 2] = (label[:, 2] * h + oy) / nh
+                    label[:, 4] = (label[:, 4] * h + oy) / nh
+                return canvas, label
+        return src, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None,
+                       min_object_covered=0.3, min_eject_coverage=0.3,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.3, 3.0), max_attempts=30,
+                       pad_val=(127, 127, 127), **kwargs):  # noqa: ARG001
+    """Standard detection augmenter chain (reference detection.py ::
+    CreateDetAugmenter); rand_crop/rand_pad are PROBABILITIES — each is
+    wrapped in DetRandomSelectAug so it fires on that fraction of samples
+    (1.0 = always)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(
+            min_object_covered=min_object_covered,
+            aspect_ratio_range=aspect_ratio_range,
+            area_range=(min(area_range[0], 1.0), min(area_range[1], 1.0)),
+            min_eject_coverage=min_eject_coverage,
+            max_attempts=max_attempts)
+        auglist.append(DetRandomSelectAug([crop],
+                                          skip_prob=1.0 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(
+            aspect_ratio_range=aspect_ratio_range,
+            area_range=(max(area_range[0], 1.0), max(area_range[1], 1.0)),
+            max_attempts=max_attempts, pad_val=pad_val)
+        auglist.append(DetRandomSelectAug([pad], skip_prob=1.0 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    # force to the network input size LAST (normalized boxes are invariant)
+    auglist.append(DetBorrowAug(ForceResizeAug(
+        (data_shape[2], data_shape[1]))))
+    if mean is not None or std is not None:
+        mean = _np.asarray(mean if mean is not None else [0, 0, 0],
+                           _np.float32)
+        std = _np.asarray(std if std is not None else [1, 1, 1], _np.float32)
+
+        class _NumpyNormalize(Augmenter):
+            def __call__(self, src, _m=mean, _s=std):
+                return (_np.asarray(src, _np.float32) - _m) / _s
+
+        auglist.append(DetBorrowAug(_NumpyNormalize()))
+    return auglist
+
+
+def _parse_det_label(raw):
+    """Packed header label -> (N, B) object array ([A, B, extras, objs])."""
+    raw = _np.asarray(raw, _np.float32).ravel()
+    if raw.size < 2:
+        return _np.zeros((0, 5), _np.float32)
+    A, B = int(raw[0]), int(raw[1])
+    if A < 2 or B < 5 or raw.size < A:
+        raise MXNetError(
+            f"invalid packed detection label: header ({raw[:2]}), "
+            f"size {raw.size}")
+    objs = raw[A:]
+    n = objs.size // B
+    return objs[: n * B].reshape(n, B).copy()
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator over packed records/.lst (reference image/
+    detection.py :: ImageDetIter over ImageDetRecordIter).
+
+    Yields DataBatch(data (N, C, H, W), label (N, max_objs, B)) with
+    unused object slots filled with -1 (id -1 = ignore, the reference
+    padding convention).  ``label_shape`` fixes (max_objs, B); when None
+    it is inferred by scanning the dataset's labels once at init.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 label_shape=None, aug_list=None, imglist=None, **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **kwargs)
+        # label_width=-1: .lst rows carry VARIABLE-width packed labels
+        # (every middle column) — a fixed width would drop the boxes
+        super().__init__(batch_size, data_shape, label_width=-1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, shuffle=shuffle,
+                         aug_list=[], imglist=imglist)
+        self.det_auglist = aug_list
+        self.label_shape = tuple(label_shape) if label_shape \
+            else self._infer_label_shape()
+
+    def _infer_label_shape(self):
+        max_objs, width = 1, 5
+        if self._rec is not None:
+            from . import recordio
+            for key in self.seq:
+                header, _ = recordio.unpack(self._rec.read_idx(key))
+                objs = _parse_det_label(header.label)
+                max_objs = max(max_objs, objs.shape[0])
+                width = max(width, objs.shape[1] if objs.size else 5)
+        else:
+            for label, _ in self.imglist:
+                objs = _parse_det_label(label)
+                max_objs = max(max_objs, objs.shape[0])
+                width = max(width, objs.shape[1] if objs.size else 5)
+        return (max_objs, width)
+
+    def __next__(self):
+        from .io.io import DataBatch
+        c, h, w = self.data_shape
+        m, bwidth = self.label_shape
+        batch_data = _np.zeros((self.batch_size, c, h, w), _np.float32)
+        batch_label = _np.full((self.batch_size, m, bwidth), -1.0,
+                               _np.float32)
+        i = 0
+        while i < self.batch_size:
+            raw_label, img = self.next_sample()
+            label = _parse_det_label(raw_label)
+            img = img.asnumpy() if isinstance(img, NDArray) else img
+            for aug in self.det_auglist:
+                img, label = aug(img, label)
+            img = img.asnumpy() if isinstance(img, NDArray) else img
+            n = min(len(label), m)
+            bw = min(label.shape[1], bwidth) if label.size else bwidth
+            if n:
+                batch_label[i, :n, :bw] = label[:n, :bw]
+            batch_data[i] = _np.asarray(img, _np.float32).transpose(2, 0, 1)
+            i += 1
+        return DataBatch([nd.array(batch_data)], [nd.array(batch_label)],
                          pad=0)
 
     next = __next__

@@ -15,6 +15,7 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
+import sys as _sys
 from collections import namedtuple
 
 import numpy as _np
@@ -107,8 +108,9 @@ class MXRecordIO:
     def __exit__(self, *exc):
         self.close()
 
-    def __del__(self):
-        import sys
+    def __del__(self, _is_finalizing=_sys.is_finalizing):
+        # _is_finalizing bound at def time: during interpreter teardown
+        # even `import` may already be None'd out
         try:
             self.close()
         except AttributeError:
@@ -118,7 +120,7 @@ class MXRecordIO:
             # `open` may already be gone); a failing close during normal
             # GC — e.g. the .idx sidecar write hitting a full disk —
             # must stay visible
-            if not sys.is_finalizing():
+            if not _is_finalizing():
                 raise
 
 

@@ -56,13 +56,21 @@ def make_list(prefix, root, shuffle=True, seed=0):
     return lst, len(rows), classes
 
 
-def read_list(path):
+def read_list(path, pack_label=False):
+    """.lst rows: idx \\t label... \\t relpath.  With ``pack_label`` every
+    middle column becomes a float vector label (the detection format:
+    [A, B, extras, (cls x0 y0 x1 y1)*N] — reference im2rec.py
+    --pack-label)."""
     with open(path) as f:
         for line in f:
             parts = line.strip().split("\t")
             if len(parts) < 3:
                 continue
-            yield int(parts[0]), float(parts[1]), parts[-1]
+            if pack_label:
+                label = [float(x) for x in parts[1:-1]]
+            else:
+                label = float(parts[1])
+            yield int(parts[0]), label, parts[-1]
 
 
 def _encode(img_path, quality, resize=0):
@@ -83,12 +91,14 @@ def _encode(img_path, quality, resize=0):
             return f.read()  # pass through already-encoded bytes
 
 
-def make_rec(prefix, root, quality=95, resize=0):
-    """Pass 2: .lst → .rec/.idx (IRHeader-packed JPEG records)."""
+def make_rec(prefix, root, quality=95, resize=0, pack_label=False):
+    """Pass 2: .lst → .rec/.idx (IRHeader-packed JPEG records); with
+    ``pack_label`` the header carries the full float label vector
+    (detection boxes — fed by mx.image.ImageDetIter)."""
     from mxnet_tpu import recordio
     rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
     n, skipped = 0, 0
-    for idx, label, rel in read_list(prefix + ".lst"):
+    for idx, label, rel in read_list(prefix + ".lst", pack_label=pack_label):
         payload = _encode(os.path.join(root, rel), quality, resize)
         if payload is None:
             skipped += 1
@@ -110,6 +120,9 @@ def main(argv=None):
     ap.add_argument("--quality", type=int, default=95)
     ap.add_argument("--resize", type=int, default=0,
                     help="resize shorter edge to N pixels (0 = keep)")
+    ap.add_argument("--pack-label", action="store_true",
+                    help="pack every middle .lst column as a float vector "
+                         "label (detection boxes)")
     args = ap.parse_args(argv)
     if args.list:
         lst, n, classes = make_list(args.prefix, args.root,
@@ -118,7 +131,8 @@ def main(argv=None):
         return 0
     if not os.path.exists(args.prefix + ".lst"):
         make_list(args.prefix, args.root, shuffle=not args.no_shuffle)
-    n, skipped = make_rec(args.prefix, args.root, args.quality, args.resize)
+    n, skipped = make_rec(args.prefix, args.root, args.quality, args.resize,
+                          pack_label=args.pack_label)
     print(f"wrote {args.prefix}.rec: {n} records ({skipped} skipped)")
     return 0
 
