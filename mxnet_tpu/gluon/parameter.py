@@ -185,11 +185,23 @@ class Parameter:
         self._check_initialized()
         return list(self._data_list)
 
-    def grad(self, ctx=None):
+    def grad(self, ctx=None, stype=None):
+        """Gradient buffer; with ``grad_stype='row_sparse'`` (e.g.
+        Embedding(sparse_grad=True)) the result is a RowSparseNDArray
+        holding only the touched rows.  TPU-native statement of the
+        reference's sparse-grad path (src/operator/tensor/indexing_op.cc
+        row_sparse Embedding backward): on device the gradient IS a fused
+        XLA scatter-add into the dense buffer — already the sparse
+        accumulation — and this view compresses it to (indices, values)
+        for kvstore push / lazy optimizer updates."""
         self._check_initialized()
         g = self._replica(ctx)._grad
         if g is None:
             raise MXNetError(f"Parameter {self.name!r} has grad_req='null'")
+        stype = stype or self.grad_stype
+        if stype == "row_sparse":
+            from ..ndarray import sparse as _sp
+            return _sp.row_sparse_array(g)
         return g
 
     def list_grad(self):

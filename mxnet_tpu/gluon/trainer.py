@@ -199,6 +199,11 @@ class Trainer:
 
     def _update(self, ignore_stale_grad=False):  # noqa: ARG002
         optzr = self._optimizer
+        agg = getattr(optzr, "aggregate_num", 0)
+        if agg > 1 and len(self._updaters) == 1 \
+                and hasattr(optzr, "update_multi"):
+            self._update_aggregated(agg)
+            return
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or p._data is None:
                 continue
@@ -217,6 +222,31 @@ class Trainer:
                         optzr._index_update_count[i] = snap_count
                     optzr.num_update = snap_num
                 upd(i, g, w)
+
+    def _update_aggregated(self, agg):
+        """Multi-tensor fast path (reference optimizer aggregation over
+        multi_sgd_update kernels, src/operator/optimizer_op.cc): groups of
+        up to ``agg`` same-dtype params update in ONE registry dispatch
+        instead of one per param.  Single-replica only — the multi-ctx
+        path keeps the per-param loop with its step-count snapshotting."""
+        upd = self._updaters[0]
+        group, group_dt = [], None
+        def flush():
+            nonlocal group, group_dt
+            if group:
+                upd.call_multi([i for i, _, _ in group],
+                               [g for _, _, g in group],
+                               [w for _, w, _ in group])
+            group, group_dt = [], None
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            w, g = p.list_data()[0], p.list_grad()[0]
+            if group and (w.dtype != group_dt or len(group) >= agg):
+                flush()
+            group.append((i, w, g))
+            group_dt = w.dtype
+        flush()
 
     def save_states(self, fname):
         """With update_on_kvstore the optimizer state lives in the store

@@ -301,6 +301,10 @@ class ImageIter:
 
     def __next__(self):
         from .io.io import DataBatch
+        if self.label_width < 0:
+            raise MXNetError(
+                "label_width=-1 (variable-width packed labels) has no "
+                "fixed batch layout — iterate with ImageDetIter instead")
         c, h, w = self.data_shape
         batch_data = _np.zeros((self.batch_size, c, h, w), _np.float32)
         batch_label = _np.zeros((self.batch_size, self.label_width),
@@ -582,16 +586,23 @@ class ImageDetIter(ImageIter):
         self.label_shape = tuple(label_shape) if label_shape \
             else self._infer_label_shape()
 
+    # .rec label-shape inference reads whole records (payload included):
+    # cap the scan so a multi-GB dataset doesn't pay minutes of startup
+    # I/O — pass label_shape explicitly for exact bounds (records beyond
+    # the sample with more objects get truncated to max_objs)
+    _LABEL_SCAN_LIMIT = 1024
+
     def _infer_label_shape(self):
         max_objs, width = 1, 5
         if self._rec is not None:
             from . import recordio
-            for key in self.seq:
+            for key in self.seq[:self._LABEL_SCAN_LIMIT]:
                 header, _ = recordio.unpack(self._rec.read_idx(key))
                 objs = _parse_det_label(header.label)
                 max_objs = max(max_objs, objs.shape[0])
                 width = max(width, objs.shape[1] if objs.size else 5)
         else:
+            # .lst labels are already in memory — scanning them all is free
             for label, _ in self.imglist:
                 objs = _parse_det_label(label)
                 max_objs = max(max_objs, objs.shape[0])

@@ -17,7 +17,8 @@ from ..context import current_context
 from .ndarray import NDArray, array as _dense_array, zeros as _dense_zeros
 
 __all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
-           "row_sparse_array", "csr_matrix", "cast_storage", "zeros"]
+           "row_sparse_array", "csr_matrix", "cast_storage", "zeros",
+           "dot", "square_sum", "sparse_retain"]
 
 
 def _jnp():
@@ -144,26 +145,40 @@ class CSRNDArray(BaseSparseNDArray):
         return NDArray._from_data(dense, ctx=self.ctx)
 
     def dot(self, dense):
-        """csr @ dense — lowers to segment-sum (TPU-friendly SpMM)."""
-        import jax
-        jnp = _jnp()
-        indptr = _np.asarray(self.indptr._data)
-        rows = _np.repeat(_np.arange(self._shape[0]), _np.diff(indptr))
-        gathered = dense._data[self.indices._data.astype(jnp.int32)] \
-            * self.data._data[:, None]
-        out = jax.ops.segment_sum(gathered, jnp.asarray(rows),
-                                  num_segments=self._shape[0])
-        return NDArray._from_data(out, ctx=self.ctx)
+        """csr @ dense — the registry SpMM kernel (``_sparse_dot_csr``:
+        gather + segment-sum, differentiable, jits with static shapes)."""
+        return dot(self, dense)
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 2:
         data, indices = arg1
         return RowSparseNDArray(data, indices, shape, ctx=ctx, dtype=dtype)
-    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    if isinstance(arg1, NDArray):
+        return row_sparse_view(arg1, ctx=ctx, dtype=dtype)
+    dense = _np.asarray(arg1)
     nz = _np.where(_np.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
     return RowSparseNDArray(dense[nz], nz.astype(_np.int64),
                             dense.shape, ctx=ctx, dtype=dtype or dense.dtype)
+
+
+def row_sparse_view(dense_nd, ctx=None, dtype=None):
+    """Compress a dense NDArray's nonzero ROWS into a RowSparseNDArray
+    without round-tripping the full buffer through the host: the row mask
+    reduces ON DEVICE (transfer = one bool per row), only the kept rows
+    are gathered (on device).  This is what Embedding(sparse_grad=True)'s
+    grad view uses — a (vocab, dim) gradient moves dim*touched floats,
+    not the whole table."""
+    jnp = _jnp()
+    gd = dense_nd._data
+    mask = _np.asarray(jnp.any(gd != 0,
+                               axis=tuple(range(1, gd.ndim))))  # (rows,)
+    idx = _np.nonzero(mask)[0]
+    vals = gd[jnp.asarray(idx)]                    # device gather
+    return RowSparseNDArray(NDArray._from_data(vals),
+                            idx.astype(_np.int64), dense_nd.shape,
+                            ctx=ctx or dense_nd.ctx,
+                            dtype=dtype or dense_nd.dtype)
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
@@ -191,6 +206,61 @@ def cast_storage(arr, stype):
         if stype == "csr":
             return csr_matrix(arr, ctx=arr.ctx, dtype=arr.dtype)
     raise MXNetError(f"cast_storage: unsupported target {stype}")
+
+
+def dot(lhs, rhs, transpose_a=False):
+    """Storage-aware dot (reference src/operator/tensor/dot.cc FComputeEx
+    paths): csr @ dense and csr.T @ dense route to the registry kernel
+    ``_sparse_dot_csr`` (gather + segment-sum SpMM, differentiable in the
+    csr values and the dense operand); dense inputs fall back to nd.dot.
+    """
+    from .. import nd as _nd
+    if isinstance(lhs, CSRNDArray):
+        if not isinstance(rhs, NDArray):
+            raise MXNetError("sparse.dot: rhs must be a dense NDArray")
+        return _nd._sparse_dot_csr(lhs.data, lhs.indptr, lhs.indices,
+                                   rhs, transpose_a=transpose_a,
+                                   num_cols=lhs.shape[1])
+    if isinstance(lhs, RowSparseNDArray):
+        return _nd.dot(lhs.tostype("default"), rhs)
+    if transpose_a:
+        return _nd.dot(lhs, rhs, transpose_a=True)
+    return _nd.dot(lhs, rhs)
+
+
+def square_sum(rsp, axis=None, keepdims=False):
+    """Sum of squares over a row_sparse array touching only stored rows
+    (reference square_sum.cc — used by lazy-update optimizers)."""
+    from .. import nd as _nd
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("square_sum expects a RowSparseNDArray")
+    return _nd._square_sum_rs(rsp.data, rsp.indices,
+                              num_rows=rsp.shape[0], axis=axis,
+                              keepdims=keepdims)
+
+
+def sparse_retain(rsp, row_ids):
+    """Functional sparse_retain (reference sparse_retain.cc): keep only
+    the listed rows.  The VALUES flow through the registry kernel
+    ``_sparse_retain_values`` + ``take`` (both differentiable, so grads
+    reach rsp.data); only the slot compaction — a data-dependent SIZE,
+    inherently host-side — runs in numpy."""
+    from .. import nd as _nd
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("sparse_retain expects a RowSparseNDArray")
+    rid = row_ids if isinstance(row_ids, NDArray) \
+        else _dense_array(_np.asarray(row_ids, _np.int64))
+    masked = _nd._sparse_retain_values(rsp.data, rsp.indices, rid)
+    jnp = _jnp()
+    keep = _np.nonzero(_np.asarray(
+        jnp.isin(rsp.indices._data,
+                 rid._data.astype(rsp.indices._data.dtype))))[0]
+    keep_nd = _dense_array(keep.astype(_np.int64))
+    kept_vals = _nd.take(masked, keep_nd, axis=0)
+    return RowSparseNDArray(
+        kept_vals,
+        NDArray._from_data(rsp.indices._data[jnp.asarray(keep)]),
+        rsp.shape, ctx=rsp.ctx, dtype=rsp.dtype)
 
 
 def zeros(stype, shape, ctx=None, dtype=None):

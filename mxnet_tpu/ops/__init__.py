@@ -20,3 +20,4 @@ from . import linalg  # noqa: F401
 from . import contrib  # noqa: F401
 from . import vision  # noqa: F401
 from . import quantization  # noqa: F401
+from . import sparse_ops  # noqa: F401

@@ -216,3 +216,85 @@ def _adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
     delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
     new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
     return weight - delta - wd * weight, new_acc_g, new_acc_delta
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor fused updates (reference src/operator/optimizer_op.cc
+# multi_sgd_update / multi_sgd_mom_update / multi_mp_sgd_* — VERDICT r3
+# item 8).  One registry dispatch updates N params: the per-param host
+# dispatch loop becomes a single jitted XLA program.  Per-param lr/wd ride
+# as INPUT vectors (traced, so schedules never recompile); the weight/grad
+# (/mom/w32) tensors arrive interleaved like the reference kernels.
+# ---------------------------------------------------------------------------
+
+
+@register("multi_sgd_update", num_outputs=-1,
+          dynamic_attrs=("rescale_grad",))
+def _multi_sgd_update(*args, rescale_grad=1.0, clip_gradient=-1.0,
+                      num_weights=0):
+    """args = w0, g0, w1, g1, ..., lrs, wds -> (w0', w1', ...)."""
+    lrs, wds = args[-2], args[-1]
+    wg = args[:-2]
+    n = int(num_weights) or len(wg) // 2
+    outs = []
+    for i in range(n):
+        w, g = wg[2 * i], wg[2 * i + 1]
+        g = _prep(g, rescale_grad, clip_gradient)
+        outs.append(w - lrs[i] * (g + wds[i] * w))
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update", num_outputs=-1,
+          dynamic_attrs=("rescale_grad", "momentum"))
+def _multi_sgd_mom_update(*args, momentum=0.0, rescale_grad=1.0,
+                          clip_gradient=-1.0, num_weights=0):
+    """args = w0, g0, m0, w1, g1, m1, ..., lrs, wds ->
+    (w0', m0', w1', m1', ...)."""
+    lrs, wds = args[-2], args[-1]
+    wgm = args[:-2]
+    n = int(num_weights) or len(wgm) // 3
+    outs = []
+    for i in range(n):
+        w, g, m = wgm[3 * i], wgm[3 * i + 1], wgm[3 * i + 2]
+        g = _prep(g, rescale_grad, clip_gradient)
+        new_m = momentum * m - lrs[i] * (g + wds[i] * w)
+        outs.extend((w + new_m, new_m))
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_update", num_outputs=-1,
+          dynamic_attrs=("rescale_grad",))
+def _multi_mp_sgd_update(*args, rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=0):
+    """args = w0, g0, w32_0, ... , lrs, wds -> (w0', w32_0', ...); the
+    update runs in f32 master weights and casts back (reference mp_sgd)."""
+    lrs, wds = args[-2], args[-1]
+    wgw = args[:-2]
+    n = int(num_weights) or len(wgw) // 3
+    outs = []
+    for i in range(n):
+        w, g, w32 = wgw[3 * i], wgw[3 * i + 1], wgw[3 * i + 2]
+        g32 = _prep(g.astype(w32.dtype), rescale_grad, clip_gradient)
+        new_w32 = w32 - lrs[i] * (g32 + wds[i] * w32)
+        outs.extend((new_w32.astype(w.dtype), new_w32))
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_mom_update", num_outputs=-1,
+          dynamic_attrs=("rescale_grad", "momentum"))
+def _multi_mp_sgd_mom_update(*args, momentum=0.0, rescale_grad=1.0,
+                             clip_gradient=-1.0, num_weights=0):
+    """args = w0, g0, m0, w32_0, ..., lrs, wds ->
+    (w0', m0', w32_0', ...)."""
+    lrs, wds = args[-2], args[-1]
+    wgmw = args[:-2]
+    n = int(num_weights) or len(wgmw) // 4
+    outs = []
+    for i in range(n):
+        w, g, m, w32 = (wgmw[4 * i], wgmw[4 * i + 1], wgmw[4 * i + 2],
+                        wgmw[4 * i + 3])
+        g32 = _prep(g.astype(w32.dtype), rescale_grad, clip_gradient)
+        new_m = momentum * m - lrs[i] * (g32 + wds[i] * w32)
+        new_w32 = w32 + new_m
+        outs.extend((new_w32.astype(w.dtype), new_m, new_w32))
+    return tuple(outs)

@@ -13,6 +13,8 @@ while bf16/fp16 weights are updated from them (mp_* parity).
 
 from __future__ import annotations
 
+import os
+
 import numpy as _np
 
 from .base import MXNetError
@@ -44,7 +46,8 @@ class Optimizer:
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  sym=None, begin_num_update=0, multi_precision=False,
-                 param_dict=None, aggregate_num=0):  # noqa: ARG002
+                 param_dict=None, aggregate_num=0):
+        self.aggregate_num = int(aggregate_num)
         self.rescale_grad = rescale_grad
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler
@@ -148,6 +151,11 @@ class Optimizer:
 @register
 class SGD(Optimizer):
     def __init__(self, momentum=0.0, lazy_update=False, **kwargs):
+        # reference optimizer.py: SGD aggregates up to
+        # MXNET_OPTIMIZER_AGGREGATION_SIZE params per fused kernel call
+        # (default 4) — the multi_sgd_update family
+        kwargs.setdefault("aggregate_num", int(os.environ.get(
+            "MXNET_OPTIMIZER_AGGREGATION_SIZE", "4")))
         super().__init__(**kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update
@@ -165,6 +173,56 @@ class SGD(Optimizer):
         else:
             nd.sgd_mom_update(weight, grad, state, out=[weight, state],
                               momentum=self.momentum, **kw)
+
+    def update_multi(self, indices, weights, grads, states):
+        """Fused N-param update — ONE dispatch via the multi_sgd_update /
+        multi_mp_sgd_* registry ops (reference optimizer_op.cc multi-
+        tensor kernels).  Numerics identical to N update() calls."""
+        for i in indices:
+            self._update_count(i)
+        lrs = nd.array(_np.array([self._get_lr(i) for i in indices],
+                                 _np.float32))
+        wds = nd.array(_np.array([self._get_wd(i) for i in indices],
+                                 _np.float32))
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        mp = [self.multi_precision and self._is_half(w.dtype)
+              for w in weights]
+        if any(mp):
+            assert all(mp), "update_multi groups must not mix precisions"
+            if self.momentum == 0.0:
+                ins, outs = [], []
+                for w, g, st in zip(weights, grads, states):
+                    ins += [w, g, st[0]]
+                    outs += [w, st[0]]
+                nd.multi_mp_sgd_update(
+                    *ins, lrs, wds, out=outs,
+                    rescale_grad=self.rescale_grad, clip_gradient=clip,
+                    num_weights=len(indices))
+            else:
+                ins, outs = [], []
+                for w, g, st in zip(weights, grads, states):
+                    ins += [w, g, st[1], st[0]]
+                    outs += [w, st[1], st[0]]
+                nd.multi_mp_sgd_mom_update(
+                    *ins, lrs, wds, out=outs, momentum=self.momentum,
+                    rescale_grad=self.rescale_grad, clip_gradient=clip,
+                    num_weights=len(indices))
+            return
+        if self.momentum == 0.0:
+            ins = [x for w, g in zip(weights, grads) for x in (w, g)]
+            nd.multi_sgd_update(
+                *ins, lrs, wds, out=list(weights),
+                rescale_grad=self.rescale_grad, clip_gradient=clip,
+                num_weights=len(indices))
+        else:
+            ins, outs = [], []
+            for w, g, m in zip(weights, grads, states):
+                ins += [w, g, m]
+                outs += [w, m]
+            nd.multi_sgd_mom_update(
+                *ins, lrs, wds, out=outs, momentum=self.momentum,
+                rescale_grad=self.rescale_grad, clip_gradient=clip,
+                num_weights=len(indices))
 
 
 @register
@@ -392,6 +450,10 @@ class Updater:
         self.states_synced = {}
 
     def __call__(self, index, grad, weight):
+        self.optimizer.update_multi_precision(
+            index, weight, grad, self._ensure_state(index, weight))
+
+    def _ensure_state(self, index, weight):
         if index not in self.states:
             self.states[index] = \
                 self.optimizer.create_state_multi_precision(index, weight)
@@ -403,8 +465,14 @@ class Updater:
             self.states[index] = _state_to_ctx(self.states[index],
                                                weight.ctx)
             self.states_synced[index] = True
-        self.optimizer.update_multi_precision(index, weight, grad,
-                                              self.states[index])
+        return self.states[index]
+
+    def call_multi(self, indices, grads, weights):
+        """Fused multi-param step (reference updater aggregation over the
+        multi_sgd kernels): one optimizer.update_multi per group."""
+        states = [self._ensure_state(i, w)
+                  for i, w in zip(indices, weights)]
+        self.optimizer.update_multi(indices, weights, grads, states)
 
     def get_states(self, dump_optimizer=False):  # noqa: ARG002
         import pickle
