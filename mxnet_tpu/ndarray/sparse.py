@@ -222,10 +222,9 @@ def dot(lhs, rhs, transpose_a=False):
                                    rhs, transpose_a=transpose_a,
                                    num_cols=lhs.shape[1])
     if isinstance(lhs, RowSparseNDArray):
-        return _nd.dot(lhs.tostype("default"), rhs)
-    if transpose_a:
-        return _nd.dot(lhs, rhs, transpose_a=True)
-    return _nd.dot(lhs, rhs)
+        return _nd.dot(lhs.tostype("default"), rhs,
+                       transpose_a=transpose_a)
+    return _nd.dot(lhs, rhs, transpose_a=transpose_a)
 
 
 def square_sum(rsp, axis=None, keepdims=False):
@@ -241,22 +240,23 @@ def square_sum(rsp, axis=None, keepdims=False):
 
 def sparse_retain(rsp, row_ids):
     """Functional sparse_retain (reference sparse_retain.cc): keep only
-    the listed rows.  The VALUES flow through the registry kernel
-    ``_sparse_retain_values`` + ``take`` (both differentiable, so grads
-    reach rsp.data); only the slot compaction — a data-dependent SIZE,
-    inherently host-side — runs in numpy."""
+    the listed rows.  Values flow through the differentiable ``take``
+    registry op (its backward scatters grads exactly to the kept slots);
+    only the slot compaction — a data-dependent SIZE, inherently
+    host-side — runs in numpy.  The standalone masking kernel
+    ``_sparse_retain_values`` (same-shape zeroing) remains available for
+    callers that need static shapes under jit."""
     from .. import nd as _nd
     if not isinstance(rsp, RowSparseNDArray):
         raise MXNetError("sparse_retain expects a RowSparseNDArray")
-    rid = row_ids if isinstance(row_ids, NDArray) \
-        else _dense_array(_np.asarray(row_ids, _np.int64))
-    masked = _nd._sparse_retain_values(rsp.data, rsp.indices, rid)
+    rid = row_ids._data if isinstance(row_ids, NDArray) \
+        else _jnp().asarray(_np.asarray(row_ids, _np.int64))
     jnp = _jnp()
     keep = _np.nonzero(_np.asarray(
         jnp.isin(rsp.indices._data,
-                 rid._data.astype(rsp.indices._data.dtype))))[0]
+                 rid.astype(rsp.indices._data.dtype))))[0]
     keep_nd = _dense_array(keep.astype(_np.int64))
-    kept_vals = _nd.take(masked, keep_nd, axis=0)
+    kept_vals = _nd.take(rsp.data, keep_nd, axis=0)
     return RowSparseNDArray(
         kept_vals,
         NDArray._from_data(rsp.indices._data[jnp.asarray(keep)]),

@@ -240,7 +240,9 @@ def _multi_sgd_update(*args, rescale_grad=1.0, clip_gradient=-1.0,
     for i in range(n):
         w, g = wg[2 * i], wg[2 * i + 1]
         g = _prep(g, rescale_grad, clip_gradient)
-        outs.append(w - lrs[i] * (g + wds[i] * w))
+        # the f32 lr/wd vectors promote half dtypes; cast back so the
+        # weight dtype (and checkpoints) match the per-param path
+        outs.append((w - lrs[i] * (g + wds[i] * w)).astype(w.dtype))
     return tuple(outs)
 
 
@@ -257,8 +259,8 @@ def _multi_sgd_mom_update(*args, momentum=0.0, rescale_grad=1.0,
     for i in range(n):
         w, g, m = wgm[3 * i], wgm[3 * i + 1], wgm[3 * i + 2]
         g = _prep(g, rescale_grad, clip_gradient)
-        new_m = momentum * m - lrs[i] * (g + wds[i] * w)
-        outs.extend((w + new_m, new_m))
+        new_m = (momentum * m - lrs[i] * (g + wds[i] * w)).astype(m.dtype)
+        outs.extend(((w + new_m).astype(w.dtype), new_m))
     return tuple(outs)
 
 

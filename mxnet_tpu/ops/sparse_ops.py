@@ -79,7 +79,12 @@ def _square_sum_rs(data, indices, num_rows=0, axis=None, keepdims=False):
     """
     import jax
     jnp = _jnp()
-    sq = data.astype(jnp.float32) ** 2
+    # accumulate in the input dtype when it is already >= f32 (x64 parity:
+    # float64 inputs must not silently degrade), f32 for half dtypes
+    acc_dt = data.dtype if data.dtype in (jnp.dtype(jnp.float32),
+                                          jnp.dtype(jnp.float64)) \
+        else jnp.float32
+    sq = data.astype(acc_dt) ** 2
     if axis is None:
         out = jnp.sum(sq)
         return out.reshape((1,) * data.ndim) if keepdims else out
@@ -88,7 +93,7 @@ def _square_sum_rs(data, indices, num_rows=0, axis=None, keepdims=False):
         if not num_rows:
             raise ValueError("_square_sum_rs(axis=1) needs num_rows")
         per_stored = jnp.sum(sq, axis=1)
-        out = jnp.zeros((int(num_rows),), jnp.float32) \
+        out = jnp.zeros((int(num_rows),), acc_dt) \
             .at[indices.astype(jnp.int32)].add(per_stored)
         return out[:, None] if keepdims else out
     if axis == 0:

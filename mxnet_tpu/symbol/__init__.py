@@ -11,3 +11,4 @@ from . import register as _register
 _GENERATED = _register.populate(_sys.modules[__name__])
 
 from . import contrib  # noqa: F401,E402
+from . import int8_pass  # noqa: F401,E402 — registers the 'INT8' backend

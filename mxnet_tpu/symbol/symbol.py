@@ -383,10 +383,13 @@ class Symbol:
         symbol`` registered via ``register_backend``.  Built-ins:
         'default'/'TPU'/'xla' — identity with rationale (operator fusion,
         memory planning and layout belong to XLA's compiler passes here,
-        so there is nothing left for a hand-rolled partitioner to do).
-        Unknown backends RAISE (the reference errors for unregistered
-        backends too; silently returning self would hide missing
-        MKLDNN/TensorRT-style integrations).
+        so there is nothing left for a hand-rolled partitioner to do) —
+        and 'INT8', a REAL rewrite that swaps FullyConnected nodes for
+        the quantize -> int8-MXU FC -> dequantize chain
+        (``symbol/int8_pass.py``; kwargs: excluded_sym_names,
+        calib_ranges).  Unknown backends RAISE (the reference errors for
+        unregistered backends too; silently returning self would hide
+        missing MKLDNN/TensorRT-style integrations).
         """
         fn = _BACKEND_REGISTRY.get(str(backend))
         if fn is None:

@@ -142,3 +142,22 @@ def test_aggregation_reduces_dispatch_count():
     assert single_n == 16 and single_m == 0   # 8 weights + 8 biases
     assert agg_n == 0 and agg_m >= 1          # grouped dispatches only
     assert agg_m <= 4                          # ceil(16/4)
+
+
+def test_multi_sgd_preserves_half_dtype():
+    """f32 lr/wd vectors must not promote bf16 params (review regression:
+    the fused path silently flipped weights to f32 after one step)."""
+    import ml_dtypes
+    w = nd.array(_rand((4,), 0).astype(ml_dtypes.bfloat16))
+    g = nd.array(_rand((4,), 1).astype(ml_dtypes.bfloat16))
+    m = nd.array(np.zeros(4, ml_dtypes.bfloat16))
+    outs = nd.multi_sgd_update(w, g,
+                               nd.array(np.array([0.1], np.float32)),
+                               nd.array(np.array([0.0], np.float32)),
+                               num_weights=1)
+    assert outs.dtype == w.dtype if not isinstance(outs, list) \
+        else outs[0].dtype == w.dtype
+    outs2 = nd.multi_sgd_mom_update(
+        w, g, m, nd.array(np.array([0.1], np.float32)),
+        nd.array(np.array([0.0], np.float32)), momentum=0.9, num_weights=1)
+    assert outs2[0].dtype == w.dtype and outs2[1].dtype == m.dtype

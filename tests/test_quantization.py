@@ -146,3 +146,44 @@ def test_quantize_net_cnn():
     qz.quantize_net(net, calib_data=[x], calib_mode="naive")
     out = net(x).asnumpy()
     assert np.abs(out - ref).max() / np.abs(ref).max() < 0.06
+
+
+def test_optimize_for_int8_pass_rewrites_fc():
+    """sym.optimize_for('INT8') is a REAL graph rewrite (reference
+    quantize_graph_pass.cc through the subgraph-backend seam): FC nodes
+    become quantize -> int8 FC -> dequantize (+ float bias), agree with
+    the float graph within int8 tolerance, and respect exclusions."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import symbol as S
+    r = np.random.RandomState(0)
+    x = S.var("data")
+    w1, b1 = S.var("w1"), S.var("b1")
+    w2 = S.var("w2")
+    h = S.relu(S.FullyConnected(x, w1, b1, num_hidden=8, name="fc1"))
+    out = S.FullyConnected(h, w2, None, num_hidden=3, no_bias=True,
+                           name="fc2")
+
+    args = {"data": mx.nd.array(r.randn(4, 6).astype(np.float32)),
+            "w1": mx.nd.array((r.randn(8, 6) * 0.4).astype(np.float32)),
+            "b1": mx.nd.array(r.randn(8).astype(np.float32) * 0.1),
+            "w2": mx.nd.array((r.randn(3, 8) * 0.4).astype(np.float32))}
+
+    ref = out.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+
+    q = out.optimize_for("INT8")
+    assert q.attr("__int8_quantized_nodes__") == "2"
+    got = q.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.05)
+    # int8 path really runs: exact float equality would be a miracle
+    assert not np.allclose(got, ref, rtol=1e-7, atol=1e-8)
+
+    # exclusion keeps fc1 float: only one node rewritten
+    q1 = out.optimize_for("INT8", excluded_sym_names=["fc1"])
+    assert q1.attr("__int8_quantized_nodes__") == "1"
+    names = " ".join(s._name for s in q1._walk())
+    assert "fc2_quantized" in names and "fc1_quantized" not in names
+
+    # calibrated ranges ride in as static quantize attrs
+    q2 = out.optimize_for("INT8", calib_ranges={"fc1": (-3.0, 3.0)})
+    qnode = [s for s in q2._walk() if s._name == "fc1_qdata"]
+    assert qnode and qnode[0].attr("min_calib_range") == -3.0

@@ -245,3 +245,41 @@ def test_tpu_flash_attention_grad_consistency():
         np.testing.assert_allclose(ga * vmask, gb * vmask,
                                    rtol=5e-2, atol=5e-2,
                                    err_msg=f"d{name} mismatch")
+
+
+def test_tpu_sparse_dot_consistency():
+    """csr SpMM kernel (gather + segment-sum) cpu-vs-tpu."""
+    from mxnet_tpu.ndarray import sparse
+    r = np.random.RandomState(31)
+    d = r.randn(8, 12).astype(np.float32)
+    d[r.rand(8, 12) > 0.35] = 0.0
+    rhs_np = r.randn(12, 5).astype(np.float32)   # ONE draw for both ctxs
+    outs = {}
+    for ctx in _ctxs():
+        with mx.context.Context(ctx):
+            csr = sparse.csr_matrix(d, ctx=ctx)
+            rhs = mx.nd.array(rhs_np, ctx=ctx)
+            outs[str(ctx)] = sparse.dot(csr, rhs).asnumpy()
+    vals = list(outs.values())
+    np.testing.assert_allclose(vals[0], vals[1], rtol=2e-2, atol=2e-3)
+    np.testing.assert_allclose(vals[0], d @ rhs_np, rtol=2e-2, atol=2e-3)
+
+
+def test_tpu_multi_sgd_consistency():
+    """Fused multi-tensor update matches singles ON THE CHIP."""
+    r = np.random.RandomState(33)
+    ws = [r.randn(6, 4).astype(np.float32) for _ in range(3)]
+    gs = [r.randn(6, 4).astype(np.float32) for _ in range(3)]
+    lrs = np.array([0.1, 0.05, 0.2], np.float32)
+    wds = np.array([0.0, 0.01, 0.0], np.float32)
+    outs = {}
+    for ctx in _ctxs():
+        ins = [x for w, g in zip(ws, gs)
+               for x in (mx.nd.array(w, ctx=ctx), mx.nd.array(g, ctx=ctx))]
+        res = mx.nd.multi_sgd_update(
+            *ins, mx.nd.array(lrs, ctx=ctx), mx.nd.array(wds, ctx=ctx),
+            rescale_grad=1.0, num_weights=3)
+        outs[str(ctx)] = [o.asnumpy() for o in res]
+    a, b = list(outs.values())
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
