@@ -125,11 +125,12 @@ class LlamaBlock(HybridBlock):
                 q, k, v, impl=self._attn_impl, axis=self._sp_axis,
                 num_kv_groups=self._heads // self._kv, causal=True)
         else:
-            vl = F.full((B,), L, dtype="int32")
             # direct q/k/v entry point: no interleave round-trip; the GQA
-            # kv-head broadcast happens inside the op next to the kernel
+            # kv-head broadcast happens inside the op next to the kernel.
+            # valid_length=None is the STATIC all-valid fact — the flash
+            # kernel compiles without any mask passes (pure causal)
             ctx_vec = F.contrib.masked_att_qkv(
-                q, k, v, vl, num_kv_groups=self._heads // self._kv,
+                q, k, v, None, num_kv_groups=self._heads // self._kv,
                 causal=True)                                # (B, H, L, D)
         attn = self.o_proj(ctx_vec.transpose((0, 2, 1, 3))
                            .reshape((B, L, self._units)))

@@ -79,34 +79,49 @@ def _pick_block(L, want):
 
 
 def _mask_block(sq_ref, skv_ref, causal, iq, ik, bq, bk):
-    """(bq, bk) bool mask for one tile; int32 iota only (x64-safe).
+    """(bq, bk) bool mask for one tile, or None when the tile needs no
+    masking at all (seg_q=None, non-causal — the static no-mask
+    specialization: every mask construction + where pass vanishes from
+    the compiled kernel).  int32 iota only (x64-safe).
 
     sq_ref block is (1, bq, LANES) (q ids broadcast over lanes), skv_ref is
     (1, SUBLANES, bk) (kv ids broadcast over sublanes) — the tile-legal
     layout trick for 1-per-row scalars."""
-    sq = sq_ref[0][:, :1]          # (bq, 1)
-    skv = skv_ref[0][:1, :]        # (1, bk)
-    mask = sq == skv
+    mask = None
+    if sq_ref is not None:
+        sq = sq_ref[0][:, :1]      # (bq, 1)
+        skv = skv_ref[0][:1, :]    # (1, bk)
+        mask = sq == skv
     if causal:
         qi = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
         ki = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
-        mask = jnp.logical_and(mask, qi >= ki)
+        cm = qi >= ki
+        mask = cm if mask is None else jnp.logical_and(mask, cm)
     return mask
 
 
 def _mask_block_T(sqT_ref, skvT_ref, causal, iq, ik, bq, bk):
-    """(bk, bq) mask — the TRANSPOSED tile for the dk/dv kernel, built
-    directly from transposed segment layouts (sqT (1, SUBLANES, bq) q ids
-    over lanes, skvT (1, bk, LANES) kv ids over sublanes) because Mosaic
-    cannot legalize a bool vector transpose (`tpu.transpose` on i1)."""
-    sq = sqT_ref[0][:1, :]         # (1, bq)
-    skv = skvT_ref[0][:, :1]       # (bk, 1)
-    mask = skv == sq               # (bk, bq)
+    """(bk, bq) mask (or None) — the TRANSPOSED tile for the dk/dv
+    kernel, built directly from transposed segment layouts (sqT
+    (1, SUBLANES, bq) q ids over lanes, skvT (1, bk, LANES) kv ids over
+    sublanes) because Mosaic cannot legalize a bool vector transpose
+    (`tpu.transpose` on i1)."""
+    mask = None
+    if sqT_ref is not None:
+        sq = sqT_ref[0][:1, :]     # (1, bq)
+        skv = skvT_ref[0][:, :1]   # (bk, 1)
+        mask = skv == sq           # (bk, bq)
     if causal:
         ki = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0) + ik * bk
         qi = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1) + iq * bq
-        mask = jnp.logical_and(mask, qi >= ki)
+        cm = qi >= ki
+        mask = cm if mask is None else jnp.logical_and(mask, cm)
     return mask
+
+
+def _apply_mask(s, mask):
+    return s if mask is None else \
+        jnp.where(mask[None], s, jnp.float32(_NEG_INF))
 
 
 def _bmm(a, b, contract_a, contract_b):
@@ -122,8 +137,12 @@ def _bmm(a, b, contract_a, contract_b):
 # forward
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, causal, scale, n_kv):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, n_kv, has_seg):
+    if has_seg:
+        sq_ref, skv_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        sq_ref = skv_ref = None
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -152,10 +171,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, o_ref, lse_ref,
         s = _bmm(q, k, 2, 2)                                  # (Hb, bq, bk)
         # NOTE a data-dependent uniform-tile fast path (skip the mask when
         # all segment ids in the tile agree) was measured SLOWER here —
-        # the pl.when-branched body defeats Mosaic's grid pipelining — so
-        # the mask is applied unconditionally
-        mask = _mask_block(sq_ref, skv_ref, causal, iq, ik, bq, bk)
-        s = jnp.where(mask[None], s, jnp.float32(_NEG_INF))
+        # the pl.when-branched body defeats Mosaic's grid pipelining.
+        # The mask only vanishes via the STATIC specialization (seg=None)
+        s = _apply_mask(s, _mask_block(sq_ref, skv_ref, causal, iq, ik,
+                                       bq, bk))
 
         m_prev = m_scr[:, :, :1]                              # (Hb, bq, 1)
         l_prev = l_scr[:, :, :1]
@@ -192,20 +211,27 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, scale, block_q, block_k, block_h,
                          "(a partial head block would silently drop heads)")
     n_q, n_kv, n_h = Lq // bq, Lk // bk, H // hb
     grid = (B, n_h, n_q, n_kv)
-    seg_q = jnp.broadcast_to(seg_q[:, :, None], (B, Lq, _LANES))
-    seg_kv = jnp.broadcast_to(seg_kv[:, None, :], (B, _SUBLANES, Lk))
+    has_seg = seg_q is not None
+    in_specs = [
+        pl.BlockSpec((1, hb, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
+        pl.BlockSpec((1, hb, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
+        pl.BlockSpec((1, hb, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
+    ]
+    inputs = [q, k, v]
+    if has_seg:
+        seg_q = jnp.broadcast_to(seg_q[:, :, None], (B, Lq, _LANES))
+        seg_kv = jnp.broadcast_to(seg_kv[:, None, :], (B, _SUBLANES, Lk))
+        in_specs += [
+            pl.BlockSpec((1, bq, _LANES), lambda b, h, i, j: (b, i, _zi())),
+            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, i, j: (b, _zi(), j)),
+        ]
+        inputs += [seg_q, seg_kv]
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                          n_kv=n_kv),
+                          n_kv=n_kv, has_seg=has_seg),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, hb, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
-            pl.BlockSpec((1, hb, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
-            pl.BlockSpec((1, hb, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
-            pl.BlockSpec((1, bq, _LANES), lambda b, h, i, j: (b, i, _zi())),
-            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, i, j: (b, _zi(), j)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, hb, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
             pl.BlockSpec((1, hb, bq, _LANES),
@@ -221,7 +247,7 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, scale, block_q, block_k, block_h,
             pltpu.VMEM((hb, bq, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, seg_q, seg_kv)
+    )(*inputs)
     return out, lse[..., 0]  # lse (B, H, Lq)
 
 
@@ -230,7 +256,12 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, scale, block_q, block_k, block_h,
 # --------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               sq_ref, skv_ref, dq_ref, dq_scr, *, causal, scale, n_kv):
+               *rest, causal, scale, n_kv, has_seg):
+    if has_seg:
+        sq_ref, skv_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
+        sq_ref = skv_ref = None
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -255,8 +286,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         bq, bk = q.shape[1], k.shape[1]
 
         s = _bmm(q, k, 2, 2)                                  # (Hb, bq, bk)
-        mask = _mask_block(sq_ref, skv_ref, causal, iq, ik, bq, bk)
-        s = jnp.where(mask[None], s, jnp.float32(_NEG_INF))
+        s = _apply_mask(s, _mask_block(sq_ref, skv_ref, causal, iq, ik,
+                                       bq, bk))
         p = jnp.exp(s - lse)          # masked entries: exp(-1e30 - lse) = 0
         dp = _bmm(do.astype(v.dtype), v, 2, 2)                # (Hb, bq, bk)
         ds = p * (dp - delta)         # ds * scale deferred to _finish
@@ -269,8 +300,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                sqT_ref, skvT_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                *, causal, scale, n_q):
+                *rest, causal, scale, n_q, has_seg):
+    if has_seg:
+        sqT_ref, skvT_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
+        sqT_ref = skvT_ref = None
     ik = pl.program_id(2)   # kv block: outer
     iq = pl.program_id(3)   # q block: inner (sequential accumulation)
 
@@ -296,8 +331,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         bq, bk = q.shape[1], k.shape[1]
 
         sT = _bmm(k, qs, 2, 2)        # transposed tile: (Hb, bk, bq)
-        maskT = _mask_block_T(sqT_ref, skvT_ref, causal, iq, ik, bq, bk)
-        sT = jnp.where(maskT[None], sT, jnp.float32(_NEG_INF))
+        sT = _apply_mask(sT, _mask_block_T(sqT_ref, skvT_ref, causal,
+                                           iq, ik, bq, bk))
         pT = jnp.exp(sT - lse)        # masked entries -> exact 0.0
         dv_scr[...] += _bmm(pT.astype(do.dtype), do, 2, 1)    # (Hb, bk, d)
         dpT = _bmm(v, do, 2, 2)                               # (Hb, bk, bq)
@@ -327,50 +362,67 @@ def _bwd(q, k, v, seg_q, seg_kv, out, lse, do, causal, scale,
                     axis=-1)                                   # (B, H, Lq)
     lse_b = jnp.broadcast_to(lse[..., None], lse.shape + (_LANES,))
     delta_b = jnp.broadcast_to(delta[..., None], delta.shape + (_LANES,))
-    # two layouts of each segment-id vector: per-sublane-row for the dq
-    # kernel's (bq, bk) mask, per-lane for the dkv kernel's (bk, bq) mask
-    seg_qr = jnp.broadcast_to(seg_q[:, :, None], (B, Lq, _LANES))
-    seg_kvl = jnp.broadcast_to(seg_kv[:, None, :], (B, _SUBLANES, Lk))
-    seg_qT = jnp.broadcast_to(seg_q[:, None, :], (B, _SUBLANES, Lq))
-    seg_kvT = jnp.broadcast_to(seg_kv[:, :, None], (B, Lk, _LANES))
+    has_seg = seg_q is not None
 
-    row_spec = pl.BlockSpec((1, hb, bq, _LANES),
-                            lambda b, h, i, j: (b, h, i, _zi()))
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, causal=causal, scale=scale, n_kv=n_kv),
-        grid=(B, n_h, n_q, n_kv),
-        in_specs=[
-            pl.BlockSpec((1, hb, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
-            pl.BlockSpec((1, hb, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
-            pl.BlockSpec((1, hb, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
-            pl.BlockSpec((1, hb, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
-            row_spec,
-            row_spec,
+    dq_specs = [
+        pl.BlockSpec((1, hb, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
+        pl.BlockSpec((1, hb, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
+        pl.BlockSpec((1, hb, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
+        pl.BlockSpec((1, hb, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
+        pl.BlockSpec((1, hb, bq, _LANES),
+                     lambda b, h, i, j: (b, h, i, _zi())),
+        pl.BlockSpec((1, hb, bq, _LANES),
+                     lambda b, h, i, j: (b, h, i, _zi())),
+    ]
+    dq_inputs = [q, k, v, do, lse_b, delta_b]
+    dkv_specs = [
+        pl.BlockSpec((1, hb, bq, D), lambda b, h, j, i: (b, h, i, _zi())),
+        pl.BlockSpec((1, hb, bk, D), lambda b, h, j, i: (b, h, j, _zi())),
+        pl.BlockSpec((1, hb, bk, D), lambda b, h, j, i: (b, h, j, _zi())),
+        pl.BlockSpec((1, hb, bq, D), lambda b, h, j, i: (b, h, i, _zi())),
+        pl.BlockSpec((1, hb, bq, _LANES),
+                     lambda b, h, j, i: (b, h, i, _zi())),
+        pl.BlockSpec((1, hb, bq, _LANES),
+                     lambda b, h, j, i: (b, h, i, _zi())),
+    ]
+    dkv_inputs = [q, k, v, do, lse_b, delta_b]
+    if has_seg:
+        # two layouts of each segment-id vector: per-sublane-row for the
+        # dq kernel's (bq, bk) mask, per-lane for the dkv (bk, bq) mask
+        seg_qr = jnp.broadcast_to(seg_q[:, :, None], (B, Lq, _LANES))
+        seg_kvl = jnp.broadcast_to(seg_kv[:, None, :], (B, _SUBLANES, Lk))
+        seg_qT = jnp.broadcast_to(seg_q[:, None, :], (B, _SUBLANES, Lq))
+        seg_kvT = jnp.broadcast_to(seg_kv[:, :, None], (B, Lk, _LANES))
+        dq_specs += [
             pl.BlockSpec((1, bq, _LANES), lambda b, h, i, j: (b, i, _zi())),
-            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, i, j: (b, _zi(), j)),
-        ],
+            pl.BlockSpec((1, _SUBLANES, bk),
+                         lambda b, h, i, j: (b, _zi(), j)),
+        ]
+        dq_inputs += [seg_qr, seg_kvl]
+        dkv_specs += [
+            pl.BlockSpec((1, _SUBLANES, bq),
+                         lambda b, h, j, i: (b, _zi(), i)),
+            pl.BlockSpec((1, bk, _LANES), lambda b, h, j, i: (b, j, _zi())),
+        ]
+        dkv_inputs += [seg_qT, seg_kvT]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale,
+                          n_kv=n_kv, has_seg=has_seg),
+        grid=(B, n_h, n_q, n_kv),
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, hb, bq, D),
                                lambda b, h, i, j: (b, h, i, _zi())),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((hb, bq, D), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b, seg_qr, seg_kvl)
+    )(*dq_inputs)
 
-    row_spec_T = pl.BlockSpec((1, hb, bq, _LANES),
-                              lambda b, h, j, i: (b, h, i, _zi()))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal=causal, scale=scale, n_q=n_q),
+        functools.partial(_dkv_kernel, causal=causal, scale=scale,
+                          n_q=n_q, has_seg=has_seg),
         grid=(B, n_h, n_kv, n_q),
-        in_specs=[
-            pl.BlockSpec((1, hb, bq, D), lambda b, h, j, i: (b, h, i, _zi())),
-            pl.BlockSpec((1, hb, bk, D), lambda b, h, j, i: (b, h, j, _zi())),
-            pl.BlockSpec((1, hb, bk, D), lambda b, h, j, i: (b, h, j, _zi())),
-            pl.BlockSpec((1, hb, bq, D), lambda b, h, j, i: (b, h, i, _zi())),
-            row_spec_T,
-            row_spec_T,
-            pl.BlockSpec((1, _SUBLANES, bq), lambda b, h, j, i: (b, _zi(), i)),
-            pl.BlockSpec((1, bk, _LANES), lambda b, h, j, i: (b, j, _zi())),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, hb, bk, D), lambda b, h, j, i: (b, h, j, _zi())),
             pl.BlockSpec((1, hb, bk, D), lambda b, h, j, i: (b, h, j, _zi())),
@@ -384,7 +436,7 @@ def _bwd(q, k, v, seg_q, seg_kv, out, lse, do, causal, scale,
             pltpu.VMEM((hb, bk, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b, seg_qT, seg_kvT)
+    )(*dkv_inputs)
     return dq, dk, dv
 
 
@@ -410,11 +462,18 @@ def flash_attention(q, k, v, seg_q=None, seg_kv=None, causal=False,
 
 
 def _canon_segs(q, k, seg_q, seg_kv):
-    B, _, Lq, _ = q.shape
-    Lk = k.shape[2]
-    if seg_q is None:
-        seg_q = jnp.zeros((B, Lq), jnp.int32)
-        seg_kv = jnp.zeros((B, Lk), jnp.int32)
+    if seg_q is None and seg_kv is None:
+        # STATIC no-mask specialization: the kernels compile without seg
+        # inputs, mask construction, or where passes (pure causal or
+        # full attention)
+        return None, None
+    if seg_q is None or seg_kv is None:
+        # equality masking cannot express "one side all-valid" without
+        # knowing the other side's ids; silently zero-filling would make
+        # real-id queries match NOTHING (all-masked garbage)
+        raise ValueError(
+            "flash_attention: pass BOTH seg_q and seg_kv or neither "
+            "(one-sided segment ids have no well-defined mask)")
     return seg_q.astype(jnp.int32), seg_kv.astype(jnp.int32)
 
 

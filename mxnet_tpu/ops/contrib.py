@@ -92,16 +92,22 @@ def _pallas_compiles():
         # bf16 lowers differently from f32, the BACKWARD kernels lower on
         # their own, and B/H > 1 keeps the grid index math from constant-
         # folding away — forward + grad in both dtypes must all compile
+        # the seg=None no-mask specialization compiles DIFFERENT pallas
+        # signatures (no seg BlockSpecs) — probe it too, or a toolchain
+        # that rejects only that IR would crash the llama default path
+        # instead of falling back to dense
         for dt in (_onp.float32, ml_dtypes.bfloat16):
             for causal in (False, True):  # causal masks a different tile set
-                x = jax.numpy.asarray(_onp.zeros((2, 2, 128, 64), dt))
+                for segs in ((seg, seg), (None, None)):
+                    x = jax.numpy.asarray(_onp.zeros((2, 2, 128, 64), dt))
 
-                def f(q, k, v, _c=causal):
-                    out = flash_attention(q, k, v, seg, seg, _c, 0.125)
-                    return out.astype(jax.numpy.float32).sum()
+                    def f(q, k, v, _c=causal, _s=segs):
+                        out = flash_attention(q, k, v, _s[0], _s[1], _c,
+                                              0.125)
+                        return out.astype(jax.numpy.float32).sum()
 
-                jax.block_until_ready(
-                    jax.grad(f, argnums=(0, 1, 2))(x, x, x))
+                    jax.block_until_ready(
+                        jax.grad(f, argnums=(0, 1, 2))(x, x, x))
         _PALLAS_PROBE[0] = True
     except Exception as e:  # noqa: BLE001 — any compile failure ⇒ fallback
         import logging
@@ -178,16 +184,34 @@ def _masked_selfatt(qkv, valid_length, heads=1, causal=False):
 
 
 def _attend(q, k, v, valid_length, causal):
-    """Shared masked-attention core on (B, H, L, D) tensors."""
+    """Shared masked-attention core on (B, H, L, D) tensors.
+
+    ``valid_length=None`` means every position is valid — a STATIC fact,
+    so the flash kernel compiles its no-mask specialization (no segment
+    inputs, no mask/where passes; pure-causal LLM training takes this
+    path) and the dense fallback skips the pad mask."""
     jnp = _jnp()
     L, D = q.shape[2], q.shape[3]
     scale = 1.0 / float(D) ** 0.5
-    steps = jnp.arange(L, dtype=jnp.int32)
-    seg = (steps[None, :] < valid_length.astype(jnp.int32)[:, None]) \
-        .astype(jnp.int32)                          # (B, L): 1=valid, 0=pad
+    if valid_length is None:
+        seg = None
+    else:
+        steps = jnp.arange(L, dtype=jnp.int32)
+        seg = (steps[None, :] < valid_length.astype(jnp.int32)[:, None]) \
+            .astype(jnp.int32)                      # (B, L): 1=valid, 0=pad
     if _flash_eligible(L, D):
         import jax
         from ..kernels.flash_attention import flash_attention
+
+        if seg is None:
+            def _tpu(q, k, v):
+                return flash_attention(q, k, v, None, None, causal, scale)
+
+            def _portable(q, k, v):
+                return _dense_sdpa(q, k, v, None, causal, scale)
+
+            return jax.lax.platform_dependent(q, k, v,
+                                              tpu=_tpu, default=_portable)
 
         def _tpu(q, k, v, seg):
             return flash_attention(q, k, v, seg, seg, causal, scale)
@@ -203,10 +227,14 @@ def _attend(q, k, v, valid_length, causal):
 
 
 @register("contrib.masked_att_qkv")
-def _masked_att_qkv(q, k, v, valid_length, num_kv_groups=1, causal=False):
+def _masked_att_qkv(q, k, v, valid_length=None, num_kv_groups=1,
+                    causal=False):
     """Masked attention over SEPARATE (B, H, L, D) q/k/v tensors — the
     modern-LLM entry point (no interleave round-trip; the BERT-era
     ``masked_selfatt`` keeps the reference transformer.cc layout).
+
+    ``valid_length=None`` = all positions valid, a static fact that lets
+    the flash kernel drop every mask pass (the causal-LLM fast path).
 
     k/v may carry fewer heads (GQA): num_kv_groups = H_q / H_kv query
     groups per kv head; the broadcast happens HERE, adjacent to the
@@ -247,9 +275,7 @@ def _sp_att_qkv(q, k, v, impl="ring", axis="sp", num_kv_groups=1,
     mesh = parallel.current_mesh()
     names = getattr(mesh, "axis_names", ()) if mesh is not None else ()
     if mesh is None or axis not in names:
-        B, L = q.shape[0], q.shape[2]
-        full = jnp.full((B,), L, jnp.int32)
-        return _attend(q, k, v, full, causal)
+        return _attend(q, k, v, None, causal)   # static all-valid
     # eager call (e.g. TrainStep's shape-resolve pass): the SP entry
     # points reshard operands across the mesh, so put the result back on
     # the caller's placement or the next eager op sees mixed devices

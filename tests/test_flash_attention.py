@@ -75,14 +75,44 @@ def test_flash_grad_parity(dt, tol, causal):
 
 
 def test_flash_no_segment_ids():
-    """seg=None means full (or pure-causal) attention over every position."""
+    """seg=None means full (or pure-causal) attention over every position
+    — the STATIC no-mask kernel specialization, fwd AND bwd (the llama
+    default path compiles exactly these kernels)."""
     q, k, v, _ = _inputs(jnp.float32)
     scale = 0.125
-    out = flash_attention(q, k, v, None, None, True, scale, interpret=True)
     ones = jnp.ones(q.shape[:1] + q.shape[2:3], jnp.int32)
-    ref = _dense_sdpa(q, k, v, ones, True, scale)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=1e-5, atol=1e-5)
+    for causal in (True, False):
+        out = flash_attention(q, k, v, None, None, causal, scale,
+                              interpret=True)
+        ref = _dense_sdpa(q, k, v, ones, causal, scale)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        # backward: no-seg dq/dkv kernels against the dense autodiff
+        w = jnp.asarray(np.random.RandomState(4).randn(*q.shape),
+                        jnp.float32)
+
+        def lf(q, k, v, _c=causal):
+            return jnp.sum(flash_attention(q, k, v, None, None, _c, scale,
+                                           interpret=True) * w * 0.01)
+
+        def ld(q, k, v, _c=causal):
+            return jnp.sum(_dense_sdpa(q, k, v, ones, _c, scale) * w * 0.01)
+
+        g1 = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(ld, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g1, g2):
+            d = float(jnp.max(jnp.abs(a - b)))
+            assert d < 1e-4, f"no-seg d{name} ({'causal' if causal else 'full'})"
+
+
+def test_flash_one_sided_segments_rejected():
+    """Mixed None/array segment ids raise (equality masking cannot express
+    one-sided all-valid — zero-filling silently masked EVERYTHING)."""
+    q, k, v, seg = _inputs(jnp.float32, L=128)
+    with pytest.raises(ValueError, match="BOTH seg_q and seg_kv"):
+        flash_attention(q, k, v, seg, None, False, 0.125, interpret=True)
+    with pytest.raises(ValueError, match="BOTH seg_q and seg_kv"):
+        flash_attention(q, k, v, None, seg, False, 0.125, interpret=True)
 
 
 def test_flash_cross_lengths():
