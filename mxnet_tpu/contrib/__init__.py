@@ -29,3 +29,5 @@ def __getattr__(name):
             f"mx.contrib.{name} is not part of the TPU rebuild: model "
             "interchange is StableHLO via HybridBlock.export() (SURVEY §7.1)")
     raise AttributeError(f"module 'mxnet_tpu.contrib' has no attribute {name!r}")
+from . import text  # noqa: F401
+from . import svrg  # noqa: F401

@@ -1,0 +1,112 @@
+"""SVRG optimization (reference python/mxnet/contrib/svrg_optimization/
+— SVRGModule + SVRGOptimizer; SURVEY §2.3 contrib sub-layers).
+
+Stochastic Variance-Reduced Gradient (Johnson & Zhang 2013): every
+``update_freq`` epochs take a snapshot w~ of the weights and the FULL
+gradient g_full(w~); each minibatch step then uses the variance-reduced
+direction  g_i(w) - g_i(w~) + g_full(w~).
+
+The reference wires this through the legacy Module API (SVRGModule
+duplicating executors for the snapshot network); here the TPU-native
+statement is a small trainer over the Gluon autograd path — the snapshot
+forward/backward reuses the SAME net with weights temporarily swapped
+(cheap under versioned NDArray slots), so there is no duplicated graph.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["SVRGTrainer"]
+
+
+class SVRGTrainer:
+    """Gluon-level SVRG (reference SVRGModule role).
+
+    Parameters
+    ----------
+    net : initialized Block; loss_fn(out, label) -> scalar-able NDArray.
+    learning_rate : SGD step size on the variance-reduced direction.
+    update_freq : epochs between snapshot/full-gradient refreshes
+        (the reference SVRGModule's update_freq contract).
+    """
+
+    def __init__(self, net, loss_fn, learning_rate=0.01, update_freq=1):
+        from .. import autograd  # noqa: F401 — fail fast on bad import
+        if update_freq < 1:
+            raise MXNetError("update_freq must be >= 1")
+        self.net = net
+        self.loss_fn = loss_fn
+        self.lr = learning_rate
+        self.update_freq = update_freq
+        self._params = [p for p in net.collect_params().values()
+                        if p.grad_req != "null"]
+        self._snapshot = None      # list[np.ndarray] — w~
+        self._full_grads = None    # list[np.ndarray] — g_full(w~)
+        self._epoch = 0
+
+    # -- snapshot machinery --------------------------------------------------
+    def _grads_at(self, weights, x, y):
+        """Gradients of the minibatch loss at the given weight values
+        (weights swapped in, restored after — versioned slots make this a
+        pointer swap, not a copy)."""
+        from .. import autograd, nd
+        saved = [p.data()._data for p in self._params]
+        try:
+            if weights is not None:
+                for p, w in zip(self._params, weights):
+                    p.set_data(nd.array(w))
+            with autograd.record():
+                loss = self.loss_fn(self.net(x), y)
+                if loss.shape:
+                    loss = loss.mean()
+            loss.backward()
+            return [_np.array(p.grad(stype="default").asnumpy())
+                    for p in self._params], float(loss.asnumpy())
+        finally:
+            if weights is not None:
+                for p, w in zip(self._params, saved):
+                    p._data._set_data(w)
+
+    def update_full_grads(self, data_iter):
+        """Take the snapshot w~ := w and accumulate the FULL gradient over
+        ``data_iter`` (reference SVRGModule.update_full_grads)."""
+        self._snapshot = [_np.array(p.data().asnumpy())
+                          for p in self._params]
+        acc, n = None, 0
+        for x, y in data_iter:
+            grads, _ = self._grads_at(None, x, y)
+            if acc is None:
+                acc = [g.copy() for g in grads]
+            else:
+                for a, g in zip(acc, grads):
+                    a += g
+            n += 1
+        if n == 0:
+            raise MXNetError("update_full_grads: empty data iterator")
+        self._full_grads = [a / n for a in acc]
+
+    def maybe_refresh(self, data_iter):
+        """Refresh snapshot every ``update_freq`` epochs; call once per
+        epoch with an iterator over the full dataset."""
+        if self._epoch % self.update_freq == 0:
+            self.update_full_grads(data_iter)
+        self._epoch += 1
+
+    # -- per-batch step ------------------------------------------------------
+    def step(self, x, y):
+        """One variance-reduced step: w -= lr * (g(w) - g(w~) + g_full).
+        Returns the minibatch loss at w."""
+        from .. import nd
+        if self._snapshot is None:
+            raise MXNetError("call update_full_grads(...) (or "
+                             "maybe_refresh) before step()")
+        cur_grads, loss = self._grads_at(None, x, y)
+        snap_grads, _ = self._grads_at(self._snapshot, x, y)
+        for p, g, gs, gf in zip(self._params, cur_grads, snap_grads,
+                                self._full_grads):
+            direction = g - gs + gf
+            p.set_data(p.data() - nd.array(self.lr * direction))
+        return loss

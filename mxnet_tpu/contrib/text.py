@@ -1,0 +1,213 @@
+"""mx.contrib.text — vocabulary + token-embedding utilities.
+
+Rebuild of the reference python/mxnet/contrib/text/ package (utils.py,
+vocab.py, embedding.py — SURVEY §2.3 contrib sub-layers): corpus token
+counting, index<->token vocabularies with reserved/unknown handling, and
+token embeddings loadable from the standard word-vector text format
+('token v0 v1 ... vD' per line, the GloVe/fastText layout).  Pretrained
+downloads are out of scope in this zero-egress build — load from a local
+file via ``CustomEmbedding`` (the reference's escape hatch for exactly
+this case); the lookup/compose/update API is the reference's.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+
+__all__ = ["count_tokens_from_str", "Vocabulary", "CustomEmbedding",
+           "CompositeEmbedding"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Corpus string -> token Counter (reference text/utils.py)."""
+    source = source_str.lower() if to_lower else source_str
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    for seq in source.split(seq_delim):
+        counter.update(t for t in seq.split(token_delim) if t)
+    return counter
+
+
+class Vocabulary:
+    """Index <-> token map (reference text/vocab.py :: Vocabulary).
+
+    Tokens rank by frequency (ties broken alphabetically, the reference
+    rule); index 0 is the unknown token; ``reserved_tokens`` follow it.
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens:
+            raise MXNetError("unknown_token must not be in reserved_tokens")
+        if len(set(reserved_tokens)) != len(reserved_tokens):
+            raise MXNetError("reserved_tokens must be unique")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = reserved_tokens
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        if counter is not None:
+            special = set(self._idx_to_token)
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq >= min_freq and tok not in special:
+                    self._idx_to_token.append(tok)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token (or list of tokens) -> index/indices; unknown -> 0."""
+        if isinstance(tokens, str):
+            return self._token_to_idx.get(tokens, 0)
+        return [self._token_to_idx.get(t, 0) for t in tokens]
+
+    def to_tokens(self, indices):
+        if isinstance(indices, int):
+            indices = [indices]
+            single = True
+        else:
+            single = False
+        out = []
+        for i in indices:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError(f"token index {i} out of range")
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out
+
+
+class CustomEmbedding(Vocabulary):
+    """Token embedding loaded from a word-vector text file (reference
+    text/embedding.py :: CustomEmbedding — and the lookup core its
+    pretrained GloVe/FastText classes share).
+
+    File format: one ``token<elem_delim>v0<elem_delim>...vD`` per line.
+    Unknown tokens map to ``init_unknown_vec`` (zeros by default).
+    """
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 vocabulary=None, init_unknown_vec=None,
+                 unknown_token="<unk>"):
+        super().__init__(counter=None, unknown_token=unknown_token)
+        vecs = {}
+        vec_len = None
+        with open(pretrained_file_path, encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                tok, elems = parts[0], parts[1:]
+                if vec_len is None:
+                    vec_len = len(elems)
+                elif len(elems) != vec_len:
+                    raise MXNetError(
+                        f"line {line_num + 1}: vector length {len(elems)} "
+                        f"!= {vec_len}")
+                if tok and tok not in vecs:
+                    vecs[tok] = _np.asarray([float(x) for x in elems],
+                                            _np.float32)
+        if vec_len is None:
+            raise MXNetError(f"no vectors found in {pretrained_file_path}")
+        self._vec_len = vec_len
+        if vocabulary is not None:
+            keep = [t for t in vocabulary.idx_to_token
+                    if t in vecs and t != self._unknown_token]
+        else:
+            keep = sorted(vecs)
+        self._idx_to_token = [self._unknown_token] + keep
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        unk = init_unknown_vec(shape=(vec_len,)) if init_unknown_vec \
+            else _np.zeros((vec_len,), _np.float32)
+        table = _np.stack([_np.asarray(unk, _np.float32).reshape(-1)]
+                          + [vecs[t] for t in keep])
+        self._idx_to_vec = nd.array(table)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        vecs = self._idx_to_vec[nd.array(_np.asarray(idx, _np.int64),
+                                         dtype=_np.int64)]
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """In-place overwrite of known tokens' vectors (reference
+        update_token_vectors; unknown tokens raise)."""
+        toks = [tokens] if isinstance(tokens, str) else list(tokens)
+        arr = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else _np.asarray(new_vectors, _np.float32)
+        arr = arr.reshape(len(toks), -1)
+        table = _np.array(self._idx_to_vec.asnumpy())   # writable copy
+        for t, vec in zip(toks, arr):
+            if t not in self._token_to_idx:
+                raise MXNetError(
+                    f"token {t!r} is unknown; only known-token vectors can "
+                    "be updated")
+            table[self._token_to_idx[t]] = vec
+        self._idx_to_vec = nd.array(table)
+
+
+class CompositeEmbedding(Vocabulary):
+    """Concatenate several embeddings' vectors per token over one shared
+    vocabulary (reference text/embedding.py :: CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        super().__init__(counter=None,
+                         unknown_token=vocabulary.unknown_token,
+                         reserved_tokens=vocabulary.reserved_tokens)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._embeds = list(token_embeddings)
+        self._vec_len = sum(e.vec_len for e in self._embeds)
+        parts = [e.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+                 for e in self._embeds]
+        self._idx_to_vec = nd.array(_np.concatenate(parts, axis=1))
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    get_vecs_by_tokens = CustomEmbedding.get_vecs_by_tokens

@@ -1,0 +1,121 @@
+"""mx.contrib.text tests (reference python/mxnet/contrib/text/ — vocab
+counting, index maps, file-loaded embeddings, composition)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+
+
+def test_count_tokens():
+    c = text.count_tokens_from_str("a b b\nc a  a", to_lower=False)
+    assert c == {"a": 3, "b": 2, "c": 1}
+    c2 = text.count_tokens_from_str("A a", to_lower=True)
+    assert c2["a"] == 2
+
+
+def test_vocabulary_ranking_and_lookup():
+    c = text.count_tokens_from_str("dog cat cat bird dog dog")
+    v = text.Vocabulary(c, unknown_token="<unk>", reserved_tokens=["<pad>"])
+    # freq rank: dog(3), cat(2), bird(1); <unk>=0, <pad>=1
+    assert v.idx_to_token == ["<unk>", "<pad>", "dog", "cat", "bird"]
+    assert v.to_indices("dog") == 2
+    assert v.to_indices(["bird", "missing"]) == [4, 0]
+    assert v.to_tokens([2, 3]) == ["dog", "cat"]
+    with pytest.raises(mx.MXNetError):
+        v.to_tokens([99])
+    v2 = text.Vocabulary(c, most_freq_count=2, min_freq=2)
+    assert v2.idx_to_token == ["<unk>", "dog", "cat"]
+
+
+def test_custom_embedding_and_composite(tmp_path):
+    p1 = os.path.join(str(tmp_path), "e1.txt")
+    with open(p1, "w") as f:
+        f.write("dog 1 2\ncat 3 4\nbird 5 6\n")
+    p2 = os.path.join(str(tmp_path), "e2.txt")
+    with open(p2, "w") as f:
+        f.write("dog 10\ncat 30\n")
+    e1 = text.CustomEmbedding(p1)
+    assert e1.vec_len == 2 and len(e1) == 4   # <unk> + 3 tokens
+    np.testing.assert_allclose(
+        e1.get_vecs_by_tokens(["dog", "nope"]).asnumpy(),
+        [[1, 2], [0, 0]])
+    np.testing.assert_allclose(e1.get_vecs_by_tokens("cat").asnumpy(),
+                               [3, 4])
+    e1.update_token_vectors("dog", mx.nd.array(np.array([[9., 9.]])))
+    np.testing.assert_allclose(e1.get_vecs_by_tokens("dog").asnumpy(),
+                               [9, 9])
+    with pytest.raises(mx.MXNetError):
+        e1.update_token_vectors("nope", mx.nd.array(np.array([[1., 1.]])))
+
+    vocab = text.Vocabulary(
+        text.count_tokens_from_str("dog cat dog"))
+    e2 = text.CustomEmbedding(p2)
+    comp = text.CompositeEmbedding(vocab, [e1, e2])
+    assert comp.vec_len == 3
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("dog").asnumpy(), [9, 9, 10])
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("cat").asnumpy(), [3, 4, 30])
+
+
+def test_custom_embedding_vocab_filter_and_errors(tmp_path):
+    p = os.path.join(str(tmp_path), "e.txt")
+    with open(p, "w") as f:
+        f.write("a 1 2\nb 3 4\n")
+    vocab = text.Vocabulary(text.count_tokens_from_str("a c a"))
+    e = text.CustomEmbedding(p, vocabulary=vocab)
+    assert e.idx_to_token == ["<unk>", "a"]   # only vocab∩file tokens
+    bad = os.path.join(str(tmp_path), "bad.txt")
+    with open(bad, "w") as f:
+        f.write("a 1 2\nb 3\n")
+    with pytest.raises(mx.MXNetError):
+        text.CustomEmbedding(bad)
+
+
+def test_svrg_matches_oracle_and_converges():
+    """SVRGTrainer (reference svrg_optimization role): the update equals
+    the numpy SVRG oracle g(w) - g(w~) + g_full on a linear model, and
+    drives a convex loss down."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.contrib.svrg import SVRGTrainer
+    r = np.random.RandomState(0)
+    X = r.randn(64, 5).astype(np.float32)
+    w_true = r.randn(5, 1).astype(np.float32)
+    Y = X @ w_true
+
+    mx.random.seed(0)
+    net = gluon.nn.Dense(1, use_bias=False, in_units=5)
+    net.initialize(mx.initializer.Normal(0.1))
+
+    def loss_fn(out, label):
+        return ((out - label) ** 2).mean()
+
+    tr = SVRGTrainer(net, loss_fn, learning_rate=0.05, update_freq=1)
+    batches = [(mx.nd.array(X[i:i + 16]), mx.nd.array(Y[i:i + 16]))
+               for i in range(0, 64, 16)]
+    w0 = net.weight.data().asnumpy().copy()
+    tr.update_full_grads(iter(batches))
+
+    # numpy oracle for the FIRST step on batch 0
+    def grad_at(w, xb, yb):
+        # loss = mean((x w^T - y)^2); dW = 2/n * (xw - y)^T x
+        e = xb @ w.T - yb
+        return (2.0 / len(xb)) * e.T @ xb
+    g_full = np.mean([grad_at(w0, X[i:i + 16], Y[i:i + 16])
+                      for i in range(0, 64, 16)], axis=0)
+    want = w0 - 0.05 * (grad_at(w0, X[:16], Y[:16])
+                        - grad_at(w0, X[:16], Y[:16]) + g_full)
+    first_loss = tr.step(*batches[0])
+    np.testing.assert_allclose(net.weight.data().asnumpy(), want,
+                               rtol=1e-4, atol=1e-6)
+
+    losses = [first_loss]
+    for epoch in range(6):
+        tr.maybe_refresh(iter(batches))
+        for xb, yb in batches:
+            losses.append(tr.step(xb, yb))
+    assert losses[-1] < 0.2 * losses[0]
