@@ -53,7 +53,8 @@ class SVRGTrainer:
         (weights swapped in, restored after — versioned slots make this a
         pointer swap, not a copy)."""
         from .. import autograd, nd
-        saved = [p.data()._data for p in self._params]
+        saved = [_np.array(p.data().asnumpy()) for p in self._params] \
+            if weights is not None else None
         try:
             if weights is not None:
                 for p, w in zip(self._params, weights):
@@ -67,8 +68,10 @@ class SVRGTrainer:
                     for p in self._params], float(loss.asnumpy())
         finally:
             if weights is not None:
+                # restore through set_data so EVERY replica gets the live
+                # weights back, not just the ctx-0 buffer
                 for p, w in zip(self._params, saved):
-                    p._data._set_data(w)
+                    p.set_data(nd.array(w))
 
     def update_full_grads(self, data_iter):
         """Take the snapshot w~ := w and accumulate the FULL gradient over

@@ -55,11 +55,16 @@ class Vocabulary:
         self._idx_to_token = [unknown_token] + reserved_tokens
         if counter is not None:
             special = set(self._idx_to_token)
-            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            # exclude special tokens BEFORE applying the frequency cap so
+            # reserved/unknown tokens in the corpus never eat the budget
+            # (reference vocab.py token-cap semantics)
+            pairs = [kv for kv in sorted(counter.items(),
+                                         key=lambda kv: (-kv[1], kv[0]))
+                     if kv[0] not in special]
             if most_freq_count is not None:
                 pairs = pairs[:most_freq_count]
             for tok, freq in pairs:
-                if freq >= min_freq and tok not in special:
+                if freq >= min_freq:
                     self._idx_to_token.append(tok)
         self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
 
@@ -122,6 +127,14 @@ class CustomEmbedding(Vocabulary):
                 parts = line.rstrip().split(elem_delim)
                 if len(parts) < 2:
                     continue
+                if line_num == 0 and len(parts) == 2:
+                    # fastText-style '<n_tokens> <dim>' header — skip it
+                    # (the reference warns and skips 1-element vectors)
+                    try:
+                        int(parts[0]), int(parts[1])
+                        continue
+                    except ValueError:
+                        pass
                 tok, elems = parts[0], parts[1:]
                 if vec_len is None:
                     vec_len = len(elems)
@@ -174,14 +187,16 @@ class CustomEmbedding(Vocabulary):
         arr = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
             else _np.asarray(new_vectors, _np.float32)
         arr = arr.reshape(len(toks), -1)
-        table = _np.array(self._idx_to_vec.asnumpy())   # writable copy
-        for t, vec in zip(toks, arr):
+        for t in toks:
             if t not in self._token_to_idx:
                 raise MXNetError(
                     f"token {t!r} is unknown; only known-token vectors can "
                     "be updated")
-            table[self._token_to_idx[t]] = vec
-        self._idx_to_vec = nd.array(table)
+        # scatter only the targeted rows on device — never round-trip the
+        # whole (V, D) table through the host
+        idx = _np.asarray([self._token_to_idx[t] for t in toks], _np.int64)
+        self._idx_to_vec = nd.NDArray._from_data(
+            self._idx_to_vec._data.at[idx].set(arr))
 
 
 class CompositeEmbedding(Vocabulary):

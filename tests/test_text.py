@@ -119,3 +119,19 @@ def test_svrg_matches_oracle_and_converges():
         for xb, yb in batches:
             losses.append(tr.step(xb, yb))
     assert losses[-1] < 0.2 * losses[0]
+
+
+def test_custom_embedding_fasttext_header_and_cap(tmp_path):
+    """fastText '<n> <dim>' header line is skipped (review regression),
+    and most_freq_count budgets exclude special tokens first."""
+    p = os.path.join(str(tmp_path), "ft.txt")
+    with open(p, "w") as f:
+        f.write("2 3\n")                    # header
+        f.write("dog 1 2 3\ncat 4 5 6\n")
+    e = text.CustomEmbedding(p)
+    assert e.vec_len == 3
+    np.testing.assert_allclose(e.get_vecs_by_tokens("cat").asnumpy(),
+                               [4, 5, 6])
+    c = {"<pad>": 5, "a": 3, "b": 2}
+    v = text.Vocabulary(c, most_freq_count=2, reserved_tokens=["<pad>"])
+    assert v.idx_to_token == ["<unk>", "<pad>", "a", "b"]
