@@ -69,6 +69,23 @@ def _inputs_for(name, mx):
         "mean": ([t(_N, _N)], {}),
         "norm": ([t(_N, _N)], {}),
         "reshape": ([t(_N, _N)], {"shape": (_N * _N,)}),
+        # r4 additions: multi-tensor fused updates + sparse SpMM kernels
+        "multi_sgd_update": (
+            [t(_N, _N), t(_N, _N), t(_N, _N), t(_N, _N),
+             nd.array(np.array([0.1, 0.2], np.float32)),
+             nd.array(np.zeros(2, np.float32))],
+            {"num_weights": 2}),
+        "multi_sgd_mom_update": (
+            [t(_N, _N), t(_N, _N), t(_N, _N),
+             t(_N, _N), t(_N, _N), t(_N, _N),
+             nd.array(np.array([0.1, 0.2], np.float32)),
+             nd.array(np.zeros(2, np.float32))],
+            {"momentum": 0.9, "num_weights": 2}),
+        "_sparse_dot_csr": (
+            [t(_N * 4), nd.array(np.linspace(0, _N * 4, _N + 1)
+                                 .astype(np.int64)),
+             nd.array(r.randint(0, _N, (_N * 4,)).astype(np.int64)),
+             t(_N, _N)], {"num_cols": _N}),
     }
     if name in overrides:
         return overrides[name]

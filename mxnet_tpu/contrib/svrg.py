@@ -53,8 +53,11 @@ class SVRGTrainer:
         (weights swapped in, restored after — versioned slots make this a
         pointer swap, not a copy)."""
         from .. import autograd, nd
-        saved = [_np.array(p.data().asnumpy()) for p in self._params] \
-            if weights is not None else None
+        from ..ndarray.ndarray import NDArray
+        # snapshot the immutable device buffers — versioned slots make
+        # this free; no host round-trip
+        saved = [NDArray._from_data(p.data()._data)
+                 for p in self._params] if weights is not None else None
         try:
             if weights is not None:
                 for p, w in zip(self._params, weights):
@@ -71,7 +74,7 @@ class SVRGTrainer:
                 # restore through set_data so EVERY replica gets the live
                 # weights back, not just the ctx-0 buffer
                 for p, w in zip(self._params, saved):
-                    p.set_data(nd.array(w))
+                    p.set_data(w)
 
     def update_full_grads(self, data_iter):
         """Take the snapshot w~ := w and accumulate the FULL gradient over

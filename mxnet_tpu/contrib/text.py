@@ -185,8 +185,11 @@ class CustomEmbedding(Vocabulary):
         update_token_vectors; unknown tokens raise)."""
         toks = [tokens] if isinstance(tokens, str) else list(tokens)
         arr = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
-            else _np.asarray(new_vectors, _np.float32)
-        arr = arr.reshape(len(toks), -1)
+            else _np.asarray(new_vectors)
+        # match the table dtype before the device scatter (a float64
+        # source would otherwise be an unsafe cast for jax's .at[].set)
+        arr = _np.asarray(arr, self._idx_to_vec.dtype).reshape(
+            len(toks), -1)
         for t in toks:
             if t not in self._token_to_idx:
                 raise MXNetError(

@@ -180,10 +180,13 @@ class SGD(Optimizer):
         tensor kernels).  Numerics identical to N update() calls."""
         for i in indices:
             self._update_count(i)
+        # lr/wd vectors must live WITH the weights (a cpu-ctx vector next
+        # to tpu-ctx params fails the jitted dispatch's device check)
+        wctx = weights[0].ctx
         lrs = nd.array(_np.array([self._get_lr(i) for i in indices],
-                                 _np.float32))
+                                 _np.float32), ctx=wctx)
         wds = nd.array(_np.array([self._get_wd(i) for i in indices],
-                                 _np.float32))
+                                 _np.float32), ctx=wctx)
         clip = self.clip_gradient if self.clip_gradient is not None else -1.0
         mp = [self.multi_precision and self._is_half(w.dtype)
               for w in weights]
