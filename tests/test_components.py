@@ -216,3 +216,62 @@ def test_parse_log():
     out = io.StringIO()
     parse_log.render(table, "md", out)
     assert "| epoch |" in out.getvalue()
+
+
+def test_library_load_python_oplib(tmp_path):
+    """mx.library.load (reference python/mxnet/library.py MXLoadLib role):
+    a python op library registers through the public seams and its ops
+    land on mx.nd; .so files get the documented guidance error."""
+    import mxnet_tpu as mx
+    lib = os.path.join(str(tmp_path), "myops.py")
+    with open(lib, "w") as f:
+        f.write(
+            "from mxnet_tpu.ops.registry import register\n"
+            "@register('my_plus_two')\n"
+            "def _my_plus_two(x):\n"
+            "    return x + 2\n")
+    new = mx.library.load(lib, verbose=False)
+    assert "my_plus_two" in new
+    out = mx.nd.my_plus_two(mx.nd.ones((2, 2)))
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 2), 3.0))
+    assert lib in mx.library.loaded_libraries()
+    # symbol namespace too
+    s = mx.sym.my_plus_two(mx.sym.var("x"))
+    ex = s.bind(mx.cpu(), {"x": mx.nd.zeros((2,))})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [2.0, 2.0])
+    with pytest.raises(mx.MXNetError, match="PYTHON"):
+        fake = os.path.join(str(tmp_path), "lib.so")
+        open(fake, "wb").close()
+        mx.library.load(fake)
+    with pytest.raises(mx.MXNetError, match="registered no"):
+        empty = os.path.join(str(tmp_path), "empty.py")
+        with open(empty, "w") as f:
+            f.write("x = 1\n")
+        mx.library.load(empty)
+
+
+def test_library_load_idempotent_and_rolls_back(tmp_path):
+    """Re-loading a library returns the cached ops; a library that raises
+    mid-registration rolls back so a fixed version can load (review
+    regressions)."""
+    import mxnet_tpu as mx
+    lib = os.path.join(str(tmp_path), "relib.py")
+    with open(lib, "w") as f:
+        f.write("from mxnet_tpu.ops.registry import register\n"
+                "@register('relib_op')\n"
+                "def _f(x):\n    return x * 3\n")
+    first = mx.library.load(lib, verbose=False)
+    assert mx.library.load(lib, verbose=False) == first   # no re-exec crash
+    broken = os.path.join(str(tmp_path), "broken.py")
+    with open(broken, "w") as f:
+        f.write("from mxnet_tpu.ops.registry import register\n"
+                "@register('broken_ok')\n"
+                "def _a(x):\n    return x\n"
+                "raise RuntimeError('boom')\n")
+    with pytest.raises(RuntimeError, match="boom"):
+        mx.library.load(broken, verbose=False)
+    with open(broken, "w") as f:   # fixed version must now load cleanly
+        f.write("from mxnet_tpu.ops.registry import register\n"
+                "@register('broken_ok')\n"
+                "def _a(x):\n    return x + 1\n")
+    assert "broken_ok" in mx.library.load(broken, verbose=False)
