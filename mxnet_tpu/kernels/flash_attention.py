@@ -46,6 +46,10 @@ _M_FLOOR = -1e4  # running-max clamp: keeps exp(s - m) an exact 0.0 for
                  # while any real logit above -1e4 is unaffected
 _LANES = 128     # VPU lane width: per-row scalars are stored broadcast over lanes
 _SUBLANES = 8    # min sublane count — kv segment ids ride a (8, bk) tile
+_STAT = 8        # stored width of per-row stats (lse/delta): the kernels
+                 # only read [:, :, :1], so a narrow stored broadcast cuts
+                 # the (B, H, L, width) HBM read/write 16x vs full lanes
+                 # (VMEM pads the lane dim either way)
 
 
 def _zi():
@@ -234,12 +238,12 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, scale, block_q, block_k, block_h,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, hb, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
-            pl.BlockSpec((1, hb, bq, _LANES),
+            pl.BlockSpec((1, hb, bq, _STAT),
                          lambda b, h, i, j: (b, h, i, _zi())),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Lq, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Lq, _STAT), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((hb, bq, _LANES), jnp.float32),
@@ -360,8 +364,8 @@ def _bwd(q, k, v, seg_q, seg_kv, out, lse, do, causal, scale,
     # delta_i = rowsum(dO * O): cheap elementwise reduce, XLA fuses it
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                                   # (B, H, Lq)
-    lse_b = jnp.broadcast_to(lse[..., None], lse.shape + (_LANES,))
-    delta_b = jnp.broadcast_to(delta[..., None], delta.shape + (_LANES,))
+    lse_b = jnp.broadcast_to(lse[..., None], lse.shape + (_STAT,))
+    delta_b = jnp.broadcast_to(delta[..., None], delta.shape + (_STAT,))
     has_seg = seg_q is not None
 
     dq_specs = [
@@ -369,9 +373,9 @@ def _bwd(q, k, v, seg_q, seg_kv, out, lse, do, causal, scale,
         pl.BlockSpec((1, hb, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
         pl.BlockSpec((1, hb, bk, D), lambda b, h, i, j: (b, h, j, _zi())),
         pl.BlockSpec((1, hb, bq, D), lambda b, h, i, j: (b, h, i, _zi())),
-        pl.BlockSpec((1, hb, bq, _LANES),
+        pl.BlockSpec((1, hb, bq, _STAT),
                      lambda b, h, i, j: (b, h, i, _zi())),
-        pl.BlockSpec((1, hb, bq, _LANES),
+        pl.BlockSpec((1, hb, bq, _STAT),
                      lambda b, h, i, j: (b, h, i, _zi())),
     ]
     dq_inputs = [q, k, v, do, lse_b, delta_b]
@@ -380,9 +384,9 @@ def _bwd(q, k, v, seg_q, seg_kv, out, lse, do, causal, scale,
         pl.BlockSpec((1, hb, bk, D), lambda b, h, j, i: (b, h, j, _zi())),
         pl.BlockSpec((1, hb, bk, D), lambda b, h, j, i: (b, h, j, _zi())),
         pl.BlockSpec((1, hb, bq, D), lambda b, h, j, i: (b, h, i, _zi())),
-        pl.BlockSpec((1, hb, bq, _LANES),
+        pl.BlockSpec((1, hb, bq, _STAT),
                      lambda b, h, j, i: (b, h, i, _zi())),
-        pl.BlockSpec((1, hb, bq, _LANES),
+        pl.BlockSpec((1, hb, bq, _STAT),
                      lambda b, h, j, i: (b, h, i, _zi())),
     ]
     dkv_inputs = [q, k, v, do, lse_b, delta_b]
