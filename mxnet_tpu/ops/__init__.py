@@ -21,3 +21,28 @@ from . import contrib  # noqa: F401
 from . import vision  # noqa: F401
 from . import quantization  # noqa: F401
 from . import sparse_ops  # noqa: F401
+
+# Reference-name ALIASES (the upstream op registry exposes legacy
+# CamelCase names alongside snake_case — `mx.nd.SequenceMask` and
+# `mx.nd.sequence_mask` are the same kernel there; the generated
+# namespaces here mirror that by aliasing registry entries).
+_ALIASES = {
+    "SequenceMask": "sequence_mask",
+    "SequenceLast": "sequence_last",
+    "SequenceReverse": "sequence_reverse",
+    "SwapAxis": "swapaxes",
+    "MakeLoss": "make_loss",
+    "BlockGrad": "stop_gradient",
+    "Pad": "pad",
+    "Cast": "cast",
+    "Reshape": "reshape",
+    "Flatten": "flatten",
+    "Concat": "concat",
+    "Softmax": "SoftmaxOutput",   # upstream: Softmax aliases the LOSS head
+    "SliceChannel": "slice_channel",
+    "ElementWiseSum": "add_n",
+    "l2_normalization": "L2Normalization",
+    "logical_xor": "broadcast_logical_xor",
+}
+for _alias, _target in _ALIASES.items():
+    registry.alias(_alias, _target)

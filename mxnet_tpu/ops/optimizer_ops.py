@@ -300,3 +300,26 @@ def _multi_mp_sgd_mom_update(*args, momentum=0.0, rescale_grad=1.0,
         new_w32 = w32 + new_m
         outs.extend((new_w32.astype(w.dtype), new_m, new_w32))
     return tuple(outs)
+
+
+@register("lars_update", num_outputs=2, dynamic_attrs=_DYN)
+def _lars_update(weight, grad, mom, lr=0.01, momentum=0.9, eta=0.001,
+                 wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 epsilon=1e-8):
+    """LARS layer-wise adaptive update (reference optimizer_op.cc
+    lars_* / multi_lars: You et al. 2017): the layer's lr scales by the
+    trust ratio ||w|| / (||g|| + wd*||w|| + eps); zero norms fall back to
+    ratio 1 (the reference guard)."""
+    jnp = _jnp()
+    g = _prep(grad, rescale_grad, clip_gradient)
+    w_norm = jnp.sqrt(jnp.sum(weight.astype(jnp.float32) ** 2))
+    g_norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+    denom = g_norm + wd * w_norm + epsilon
+    # zero norms fall back to the PLAIN lr (reference guard: lars factor
+    # 1.0 means lr itself; eta only scales inside the trust ratio)
+    lr_eff = jnp.where((w_norm > 0) & (g_norm > 0),
+                       lr * eta * (w_norm / denom),
+                       lr).astype(jnp.float32)
+    new_mom = momentum * mom + lr_eff * (g + wd * weight)
+    return (weight - new_mom).astype(weight.dtype), \
+        new_mom.astype(mom.dtype)

@@ -118,6 +118,17 @@ def get(name):
         raise MXNetError(f"no such operator: {name!r}") from None
 
 
+def alias(new_name, existing_name):
+    """Expose an op under a second name (the upstream registries carry
+    legacy CamelCase aliases next to snake_case).  Fails loudly on a
+    missing target or a name collision — same invariants as register()."""
+    if existing_name not in _REGISTRY:
+        raise MXNetError(f"alias target {existing_name!r} not registered")
+    if new_name in _REGISTRY:
+        raise MXNetError(f"op {new_name!r} already registered")
+    _REGISTRY[new_name] = _REGISTRY[existing_name]
+
+
 def list_ops():
     return sorted(_REGISTRY)
 

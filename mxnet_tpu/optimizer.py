@@ -21,7 +21,7 @@ from .base import MXNetError
 from . import ndarray as nd
 from .ndarray.ndarray import NDArray
 
-__all__ = ["Optimizer", "SGD", "Adam", "AdamW", "NAG", "RMSProp", "Ftrl",
+__all__ = ["Optimizer", "SGD", "Adam", "AdamW", "NAG", "LARS", "RMSProp", "Ftrl",
            "Signum", "SignSGD", "LAMB", "AdaGrad", "AdaDelta", "create",
            "register", "Updater", "get_updater"]
 
@@ -226,6 +226,40 @@ class SGD(Optimizer):
                 *ins, lrs, wds, out=outs, momentum=self.momentum,
                 rescale_grad=self.rescale_grad, clip_gradient=clip,
                 num_weights=len(indices))
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise Adaptive Rate Scaling (reference optimizer.py :: LARS
+    over optimizer_op.cc lars_*/multi_lars — large-batch SGD, You et al.
+    2017).  Per-layer lr scales by trust = ||w|| / (||g|| + wd*||w||+eps)
+    via the fused ``lars_update`` op."""
+
+    def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx)
+
+    def _skip_trust(self, index):
+        # reference LARS excludes bias/gamma/beta from layer adaptation
+        name = self.idx2name.get(index, "")
+        return name.endswith(("bias", "gamma", "beta"))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if self._skip_trust(index):
+            # trust ratio forced to 1: plain momentum SGD
+            nd.sgd_mom_update(weight, grad, state, out=[weight, state],
+                              momentum=self.momentum, **kw)
+        else:
+            nd.lars_update(weight, grad, state, out=[weight, state],
+                           momentum=self.momentum, eta=self.eta,
+                           epsilon=self.epsilon, **kw)
 
 
 @register

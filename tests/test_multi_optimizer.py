@@ -161,3 +161,88 @@ def test_multi_sgd_preserves_half_dtype():
         w, g, m, nd.array(np.array([0.1], np.float32)),
         nd.array(np.array([0.0], np.float32)), momentum=0.9, num_weights=1)
     assert outs2[0].dtype == w.dtype and outs2[1].dtype == m.dtype
+
+
+def test_lars_update_matches_oracle():
+    """lars_update (reference optimizer_op.cc lars_* family): trust-ratio
+    scaled momentum SGD, zero-norm fallback to ratio 1."""
+    r = np.random.RandomState(1)
+    w = r.randn(8).astype(np.float32)
+    g = r.randn(8).astype(np.float32)
+    m = r.randn(8).astype(np.float32) * 0.1
+    wn, mn = nd.lars_update(nd.array(w), nd.array(g), nd.array(m),
+                            lr=0.2, momentum=0.9, eta=0.01, wd=0.001)
+    wnorm = np.linalg.norm(w)
+    gnorm = np.linalg.norm(g)
+    trust = wnorm / (gnorm + 0.001 * wnorm + 1e-8)
+    mref = 0.9 * m + 0.2 * 0.01 * trust * (g + 0.001 * w)
+    np.testing.assert_allclose(mn.asnumpy(), mref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(wn.asnumpy(), w - mref, rtol=1e-5, atol=1e-6)
+    # zero weight -> trust ratio 1 (no div-by-zero blowup)
+    w0 = np.zeros(4, np.float32)
+    wn0, _ = nd.lars_update(nd.array(w0), nd.array(np.ones(4, np.float32)),
+                            nd.array(np.zeros(4, np.float32)), lr=0.1,
+                            momentum=0.0, eta=0.5)
+    # reference guard: zero norms -> PLAIN lr (eta only inside the ratio)
+    np.testing.assert_allclose(wn0.asnumpy(), -0.1 * np.ones(4), rtol=1e-6)
+
+
+def test_lars_optimizer_trains():
+    from mxnet_tpu import gluon
+    mx.random.seed(2)
+    net = gluon.nn.Dense(1, in_units=4)
+    net.initialize(mx.initializer.Normal(0.2))
+    tr = gluon.Trainer(net.collect_params(), "lars",
+                       {"learning_rate": 1.0, "eta": 0.1, "momentum": 0.9})
+    lf = gluon.loss.L2Loss()
+    r = np.random.RandomState(0)
+    X = r.randn(32, 4).astype(np.float32)
+    Y = (X @ r.randn(4, 1)).astype(np.float32)
+    losses = []
+    for _ in range(25):
+        with autograd.record():
+            loss = lf(net(mx.nd.array(X)), mx.nd.array(Y))
+        loss.backward()
+        tr.step(32)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < 0.5 * losses[0]
+
+
+def test_reference_camelcase_aliases():
+    """Upstream exposes legacy CamelCase op names alongside snake_case —
+    both must resolve to the same kernels."""
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(nd.SwapAxis(x, dim1=0, dim2=1).asnumpy(),
+                               x.asnumpy().T)
+    np.testing.assert_allclose(nd.Reshape(x, shape=(3, 2)).asnumpy(),
+                               x.asnumpy().reshape(3, 2))
+    np.testing.assert_allclose(nd.Flatten(x).asnumpy(), x.asnumpy())
+    np.testing.assert_allclose(
+        nd.Concat(x, x, dim=0).asnumpy(),
+        np.concatenate([x.asnumpy()] * 2, axis=0))
+    np.testing.assert_allclose(
+        nd.logical_xor(nd.array(np.array([0., 1., 1.])),
+                       nd.array(np.array([1., 1., 0.]))).asnumpy(),
+        [1.0, 0.0, 1.0])
+    seq = nd.SequenceMask(
+        nd.array(np.ones((3, 2, 2), np.float32)),
+        nd.array(np.array([1., 2.])),
+        use_sequence_length=True)
+    assert seq.asnumpy()[2, 0].sum() == 0.0   # masked beyond length
+
+
+def test_lars_skips_trust_for_bias_gamma_beta():
+    """Reference LARS excludes bias/gamma/beta from layer adaptation:
+    those params update with plain momentum SGD."""
+    opt = mx.optimizer.create("lars", learning_rate=0.5, momentum=0.0,
+                              eta=0.001,
+                              param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    w = np.ones(4, np.float32)
+    g = np.full(4, 0.2, np.float32)
+    wt = nd.array(w)
+    opt.update(1, wt, nd.array(g), opt.create_state(1, wt))
+    # plain sgd: w - lr*g (no tiny-eta trust scaling)
+    np.testing.assert_allclose(wt.asnumpy(), w - 0.5 * g, rtol=1e-6)
+    wt2 = nd.array(w)
+    opt.update(0, wt2, nd.array(g), opt.create_state(0, wt2))
+    assert not np.allclose(wt2.asnumpy(), w - 0.5 * g)   # trust applied
