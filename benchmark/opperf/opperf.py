@@ -160,8 +160,20 @@ def bench_op(name, mx, warmup=2, runs=10, with_backward=True):
 def run(ops=None, output="json", warmup=2, runs=10):
     import mxnet_tpu as mx
     from mxnet_tpu.ops import registry
-    names = ops if ops else [n for n in registry.list_ops()
-                             if not n.startswith("_")]
+    if ops:
+        names = ops
+    else:
+        # registry aliases (SwapAxis == swapaxes, ...) map to the SAME Op
+        # object — sweep each kernel once, under its first-listed name
+        seen, names = set(), []
+        for n in registry.list_ops():
+            if n.startswith("_"):
+                continue
+            op_id = id(registry.get(n))
+            if op_id in seen:
+                continue
+            seen.add(op_id)
+            names.append(n)
     rows = [bench_op(n, mx, warmup, runs) for n in names]
     if output == "md":
         print("| op | fwd ms | fwd+bwd ms | note |")

@@ -350,6 +350,80 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      *rest, causal, scale, has_seg):
+    """Single-tile fused backward (n_q == n_kv == 1, i.e. seq <= block):
+    dq, dk, dv from ONE pass — s and p computed once, dk/dv contract over
+    the q dim (no transposes), inputs loaded once instead of twice.  The
+    split dq/dkv kernels remain for multi-tile (long-seq) grids where
+    dk/dv accumulation runs across q blocks."""
+    if has_seg:
+        sq_ref, skv_ref, dq_ref, dk_ref, dv_ref = rest
+    else:
+        dq_ref, dk_ref, dv_ref = rest
+        sq_ref = skv_ref = None
+    q = q_ref[0]                                          # (Hb, bq, d)
+    qs = q * jnp.asarray(scale, q_ref.dtype)
+    k = k_ref[0]                                          # (Hb, bk, d)
+    v = v_ref[0]
+    do = do_ref[0]                                        # (Hb, bq, d)
+    lse = lse_ref[0][:, :, :1]                            # (Hb, bq, 1)
+    delta = delta_ref[0][:, :, :1]                        # (Hb, bq, 1)
+    bq, bk = q.shape[1], k.shape[1]
+
+    s = _bmm(qs, k, 2, 2)                                 # (Hb, bq, bk)
+    s = _apply_mask(s, _mask_block(sq_ref, skv_ref, causal,
+                                   jnp.int32(0), jnp.int32(0), bq, bk))
+    p = jnp.exp(s - lse)              # masked entries -> exact 0.0
+    dp = _bmm(do.astype(v.dtype), v, 2, 2)                # (Hb, bq, bk)
+    ds = p * (dp - delta)
+    dq_ref[0] = (_bmm(ds.astype(k.dtype), k, 2, 1)
+                 * jnp.float32(scale)).astype(dq_ref.dtype)
+    # contract over bq (dim 1 of both operands): the transposed products
+    # without any transpose op
+    dv_ref[0] = _bmm(p.astype(do.dtype), do, 1, 1).astype(dv_ref.dtype)
+    dk_ref[0] = (_bmm(ds.astype(q.dtype), q, 1, 1)
+                 * jnp.float32(scale)).astype(dk_ref.dtype)
+
+
+def _bwd_fused(q, k, v, seg_q, seg_kv, lse_b, delta_b, do, causal, scale,
+               hb, interpret):
+    """pallas_call wrapper for the single-tile fused backward."""
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    n_h = H // hb
+    has_seg = seg_q is not None
+    spec_q = pl.BlockSpec((1, hb, Lq, D), lambda b, h: (b, h, _zi(), _zi()))
+    spec_k = pl.BlockSpec((1, hb, Lk, D), lambda b, h: (b, h, _zi(), _zi()))
+    spec_stat = pl.BlockSpec((1, hb, Lq, _STAT),
+                             lambda b, h: (b, h, _zi(), _zi()))
+    in_specs = [spec_q, spec_k, spec_k, spec_q, spec_stat, spec_stat]
+    inputs = [q, k, v, do, lse_b, delta_b]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, Lq, _LANES), lambda b, h: (b, _zi(), _zi())),
+            pl.BlockSpec((1, _SUBLANES, Lk),
+                         lambda b, h: (b, _zi(), _zi())),
+        ]
+        inputs += [
+            jnp.broadcast_to(seg_q[:, :, None], (B, Lq, _LANES)),
+            jnp.broadcast_to(seg_kv[:, None, :], (B, _SUBLANES, Lk)),
+        ]
+    return pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, causal=causal, scale=scale,
+                          has_seg=has_seg),
+        grid=(B, n_h),
+        in_specs=in_specs,
+        out_specs=[spec_q, spec_k, spec_k],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(*inputs)
+
+
 def _bwd(q, k, v, seg_q, seg_kv, out, lse, do, causal, scale,
          block_q, block_k, block_h, interpret):
     B, H, Lq, D = q.shape
@@ -367,6 +441,12 @@ def _bwd(q, k, v, seg_q, seg_kv, out, lse, do, causal, scale,
     lse_b = jnp.broadcast_to(lse[..., None], lse.shape + (_STAT,))
     delta_b = jnp.broadcast_to(delta[..., None], delta.shape + (_STAT,))
     has_seg = seg_q is not None
+
+    if n_q == 1 and n_kv == 1:
+        # whole sequence in one tile: fused dq/dk/dv kernel (one s + one
+        # exp + shared loads; see _bwd_fused_kernel)
+        return _bwd_fused(q, k, v, seg_q, seg_kv, lse_b, delta_b, do,
+                          causal, scale, hb, interpret)
 
     dq_specs = [
         pl.BlockSpec((1, hb, bq, D), lambda b, h, i, j: (b, h, i, _zi())),

@@ -245,8 +245,12 @@ class LARS(Optimizer):
         return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx)
 
     def _skip_trust(self, index):
-        # reference LARS excludes bias/gamma/beta from layer adaptation
+        # reference LARS excludes bias/gamma/beta from layer adaptation.
+        # Gluon Trainer populates param_dict (not idx2name), so consult
+        # the Parameter's name there too
         name = self.idx2name.get(index, "")
+        if not name:
+            name = getattr(self.param_dict.get(index), "name", "") or ""
         return name.endswith(("bias", "gamma", "beta"))
 
     def update(self, index, weight, grad, state):

@@ -246,3 +246,28 @@ def test_lars_skips_trust_for_bias_gamma_beta():
     wt2 = nd.array(w)
     opt.update(0, wt2, nd.array(g), opt.create_state(0, wt2))
     assert not np.allclose(wt2.asnumpy(), w - 0.5 * g)   # trust applied
+
+
+def test_lars_trainer_excludes_bias(seeded):
+    """The bias exclusion must work through the PRIMARY path — gluon
+    Trainer populates param_dict, not idx2name (review regression)."""
+    from mxnet_tpu import gluon
+    mx.random.seed(4)
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize(mx.initializer.Normal(0.3))
+    tr = gluon.Trainer(net.collect_params(), "lars",
+                       {"learning_rate": 0.5, "momentum": 0.0,
+                        "eta": 0.001})
+    lf = gluon.loss.L2Loss()
+    x = mx.nd.array(np.ones((4, 3), np.float32))
+    y = mx.nd.array(np.zeros((4, 2), np.float32))
+    b0 = net.bias.data().asnumpy().copy()
+    with autograd.record():
+        loss = lf(net(x), y)
+    loss.backward()
+    gb = net.bias.grad().asnumpy().copy()
+    tr.step(1)
+    # bias updated with PLAIN lr (trust forced to 1), i.e. -lr * grad,
+    # not the ~1000x smaller eta-scaled step
+    np.testing.assert_allclose(net.bias.data().asnumpy(), b0 - 0.5 * gb,
+                               rtol=1e-4, atol=1e-6)
