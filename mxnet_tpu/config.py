@@ -60,6 +60,11 @@ KNOWN_VARS = {
         "If 1 (default), contrib.masked_selfatt lowers to the Pallas flash "
         "attention kernel on TPU (seq multiple of 128); 0 forces the dense "
         "masked-softmax fallback everywhere."),
+    "MXNET_FLASH_MIN_SEQ": (
+        "256", int,
+        "Shortest sequence the flash kernel handles; below it the dense "
+        "path wins on measured v5e step time (XLA's fused softmax beats "
+        "per-grid-step kernel cost at tiny (L, L) tiles)."),
     "MXNET_TPU_JIT_IMPERATIVE": (
         "1", int,
         "If 1, imperative op dispatch goes through a per-(op,shape,dtype,attrs) "

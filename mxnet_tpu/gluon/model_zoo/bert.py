@@ -55,8 +55,9 @@ class BERTEncoderCell(HybridBlock):
         # valid_length (B,): padding positions neither attend nor are
         # attended to (GluonNLP BERT masking contract).
         qkv = self.attn_qkv(x)
-        if valid_length is None:
-            valid_length = F.full((x.shape[1],), x.shape[0], dtype="int32")
+        # valid_length None = every position valid, a STATIC fact: the
+        # flash kernel compiles without mask passes (padded batches pass
+        # real lengths and get the segment-masked kernels)
         ctx_vec = F.contrib.masked_selfatt(qkv, valid_length,
                                            heads=self._num_heads)
         out = self.layer_norm_att(x + self.drop(self.attn_proj(ctx_vec)))

@@ -123,6 +123,19 @@ def _mask_block_T(sqT_ref, skvT_ref, causal, iq, ik, bq, bk):
     return mask
 
 
+def _seg_row_layout(seg, L):
+    """Segment ids per SUBLANE row — (B, L, _LANES), the tile-legal layout
+    for q-side ids in (bq, bk) masks.  THE single definition of the
+    layout trick; every kernel builder uses these helpers."""
+    return jnp.broadcast_to(seg[:, :, None], (seg.shape[0], L, _LANES))
+
+
+def _seg_lane_layout(seg, L):
+    """Segment ids per LANE — (B, _SUBLANES, L), for kv-side ids in
+    (bq, bk) masks and q-side ids in transposed (bk, bq) masks."""
+    return jnp.broadcast_to(seg[:, None, :], (seg.shape[0], _SUBLANES, L))
+
+
 def _apply_mask(s, mask):
     return s if mask is None else \
         jnp.where(mask[None], s, jnp.float32(_NEG_INF))
@@ -204,6 +217,71 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, n_kv, has_seg):
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
+def _fwd_single_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, has_seg):
+    """Single-tile forward (n_q == n_kv == 1): direct softmax, no
+    streaming scratch — the running-max/alpha machinery exists only to
+    stitch kv blocks together."""
+    if has_seg:
+        sq_ref, skv_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
+        sq_ref = skv_ref = None
+    q = q_ref[0] * jnp.asarray(scale, q_ref.dtype)        # (Hb, bq, d)
+    k = k_ref[0]
+    v = v_ref[0]
+    bq, bk = q.shape[1], k.shape[1]
+    s = _bmm(q, k, 2, 2)                                  # (Hb, bq, bk)
+    s = _apply_mask(s, _mask_block(sq_ref, skv_ref, causal,
+                                   jnp.int32(0), jnp.int32(0), bq, bk))
+    m = jnp.maximum(jnp.max(s, axis=2, keepdims=True),
+                    jnp.float32(_M_FLOOR))                # (Hb, bq, 1)
+    p = jnp.exp(s - m)            # masked: exp(-1e30 - m) == exact 0.0
+    l = jnp.sum(p, axis=2, keepdims=True)
+    safe_l = jnp.where(l == jnp.float32(0.0), jnp.float32(1.0), l)
+    o_ref[0] = (_bmm(p.astype(v.dtype), v, 2, 1) / safe_l) \
+        .astype(o_ref.dtype)
+    lse = m + jnp.log(safe_l)
+    lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _fwd_single(q, k, v, seg_q, seg_kv, causal, scale, hb, interpret):
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    n_h = H // hb
+    has_seg = seg_q is not None
+    spec_q = pl.BlockSpec((1, hb, Lq, D), lambda b, h: (b, h, _zi(), _zi()))
+    spec_k = pl.BlockSpec((1, hb, Lk, D), lambda b, h: (b, h, _zi(), _zi()))
+    in_specs = [spec_q, spec_k, spec_k]
+    inputs = [q, k, v]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, Lq, _LANES), lambda b, h: (b, _zi(), _zi())),
+            pl.BlockSpec((1, _SUBLANES, Lk),
+                         lambda b, h: (b, _zi(), _zi())),
+        ]
+        inputs += [
+            _seg_row_layout(seg_q, Lq),
+            _seg_lane_layout(seg_kv, Lk),
+        ]
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_single_kernel, causal=causal, scale=scale,
+                          has_seg=has_seg),
+        grid=(B, n_h),
+        in_specs=in_specs,
+        out_specs=[
+            spec_q,
+            pl.BlockSpec((1, hb, Lq, _STAT),
+                         lambda b, h: (b, h, _zi(), _zi())),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Lq, _STAT), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*inputs)
+    return out, lse[..., 0]
+
+
 def _fwd(q, k, v, seg_q, seg_kv, causal, scale, block_q, block_k, block_h,
          interpret):
     B, H, Lq, D = q.shape
@@ -214,6 +292,10 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, scale, block_q, block_k, block_h,
         raise ValueError(f"block_h={hb} must divide num heads {H} "
                          "(a partial head block would silently drop heads)")
     n_q, n_kv, n_h = Lq // bq, Lk // bk, H // hb
+    if n_q == 1 and n_kv == 1:
+        # whole sequence in one tile: direct-softmax kernel, no streaming
+        return _fwd_single(q, k, v, seg_q, seg_kv, causal, scale, hb,
+                           interpret)
     grid = (B, n_h, n_q, n_kv)
     has_seg = seg_q is not None
     in_specs = [
@@ -223,8 +305,8 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, scale, block_q, block_k, block_h,
     ]
     inputs = [q, k, v]
     if has_seg:
-        seg_q = jnp.broadcast_to(seg_q[:, :, None], (B, Lq, _LANES))
-        seg_kv = jnp.broadcast_to(seg_kv[:, None, :], (B, _SUBLANES, Lk))
+        seg_q = _seg_row_layout(seg_q, Lq)
+        seg_kv = _seg_lane_layout(seg_kv, Lk)
         in_specs += [
             pl.BlockSpec((1, bq, _LANES), lambda b, h, i, j: (b, i, _zi())),
             pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, i, j: (b, _zi(), j)),
@@ -406,8 +488,8 @@ def _bwd_fused(q, k, v, seg_q, seg_kv, lse_b, delta_b, do, causal, scale,
                          lambda b, h: (b, _zi(), _zi())),
         ]
         inputs += [
-            jnp.broadcast_to(seg_q[:, :, None], (B, Lq, _LANES)),
-            jnp.broadcast_to(seg_kv[:, None, :], (B, _SUBLANES, Lk)),
+            _seg_row_layout(seg_q, Lq),
+            _seg_lane_layout(seg_kv, Lk),
         ]
     return pl.pallas_call(
         functools.partial(_bwd_fused_kernel, causal=causal, scale=scale,
@@ -473,10 +555,10 @@ def _bwd(q, k, v, seg_q, seg_kv, out, lse, do, causal, scale,
     if has_seg:
         # two layouts of each segment-id vector: per-sublane-row for the
         # dq kernel's (bq, bk) mask, per-lane for the dkv (bk, bq) mask
-        seg_qr = jnp.broadcast_to(seg_q[:, :, None], (B, Lq, _LANES))
-        seg_kvl = jnp.broadcast_to(seg_kv[:, None, :], (B, _SUBLANES, Lk))
-        seg_qT = jnp.broadcast_to(seg_q[:, None, :], (B, _SUBLANES, Lq))
-        seg_kvT = jnp.broadcast_to(seg_kv[:, :, None], (B, Lk, _LANES))
+        seg_qr = _seg_row_layout(seg_q, Lq)
+        seg_kvl = _seg_lane_layout(seg_kv, Lk)
+        seg_qT = _seg_lane_layout(seg_q, Lq)
+        seg_kvT = _seg_row_layout(seg_kv, Lk)
         dq_specs += [
             pl.BlockSpec((1, bq, _LANES), lambda b, h, i, j: (b, i, _zi())),
             pl.BlockSpec((1, _SUBLANES, bk),

@@ -96,18 +96,26 @@ def _pallas_compiles():
         # signatures (no seg BlockSpecs) — probe it too, or a toolchain
         # that rejects only that IR would crash the llama default path
         # instead of falling back to dense
+        # blocks=None exercises the SINGLE-TILE fused kernels (seq <=
+        # block); blocks=64 forces a multi-tile grid so the STREAMING
+        # kernels (scratch accumulators, pl.when pipelining) compile too —
+        # both IR families must pass or the dense fallback engages
         for dt in (_onp.float32, ml_dtypes.bfloat16):
             for causal in (False, True):  # causal masks a different tile set
                 for segs in ((seg, seg), (None, None)):
-                    x = jax.numpy.asarray(_onp.zeros((2, 2, 128, 64), dt))
+                    for blocks in (None, 64):
+                        x = jax.numpy.asarray(
+                            _onp.zeros((2, 2, 128, 64), dt))
+                        bkw = {} if blocks is None else \
+                            {"block_q": blocks, "block_k": blocks}
 
-                    def f(q, k, v, _c=causal, _s=segs):
-                        out = flash_attention(q, k, v, _s[0], _s[1], _c,
-                                              0.125)
-                        return out.astype(jax.numpy.float32).sum()
+                        def f(q, k, v, _c=causal, _s=segs, _b=bkw):
+                            out = flash_attention(q, k, v, _s[0], _s[1],
+                                                  _c, 0.125, **_b)
+                            return out.astype(jax.numpy.float32).sum()
 
-                    jax.block_until_ready(
-                        jax.grad(f, argnums=(0, 1, 2))(x, x, x))
+                        jax.block_until_ready(
+                            jax.grad(f, argnums=(0, 1, 2))(x, x, x))
         _PALLAS_PROBE[0] = True
     except Exception as e:  # noqa: BLE001 — any compile failure ⇒ fallback
         import logging
@@ -124,15 +132,17 @@ def _flash_eligible(seq, head_dim):
     (lane-aligned seq blocks); the platform choice itself happens at XLA
     lowering via lax.platform_dependent, never by host-side guessing.
 
-    The seq >= 256 floor is measured, not structural: at seq 128 the dense
-    path's (L, L) tiles are small enough that XLA's fused softmax beats
-    the flash kernel's per-grid-step cost (BERT-base bench: 0.50 vs 0.41
-    MFU), while at 512 flash wins (0.40 vs 0.35) and by 2048 dense memory
-    is prohibitive."""
+    The seq floor (MXNET_FLASH_MIN_SEQ, default 256) is measured, not
+    structural: at seq 128 the dense path's (L, L) tiles are small enough
+    that XLA's fused softmax beats the flash kernel's per-grid-step cost
+    (BERT-base bench: 0.50 vs 0.41 MFU with the streaming kernels), while
+    at 512 flash wins (0.43 vs 0.35 after the single-tile fusion) and by
+    2048 dense memory is prohibitive."""
     from .. import config
     if not config.get_int("MXNET_FUSED_ATTENTION", 1):
         return False
-    return seq >= 256 and seq % 128 == 0 and head_dim % 8 == 0 \
+    floor = config.get_int("MXNET_FLASH_MIN_SEQ", 256)
+    return seq >= floor and seq % 128 == 0 and head_dim % 8 == 0 \
         and _pallas_compiles()
 
 
@@ -155,7 +165,7 @@ def _dense_sdpa(q, k, v, seg, causal, scale):
 
 
 @register("contrib.masked_selfatt")
-def _masked_selfatt(qkv, valid_length, heads=1, causal=False):
+def _masked_selfatt(qkv, valid_length=None, heads=1, causal=False):
     """Fused masked multi-head self-attention.
 
     The single-op TPU replacement for the reference's
