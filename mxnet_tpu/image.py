@@ -596,6 +596,14 @@ class ImageDetIter(ImageIter):
         max_objs, width = 1, 5
         if self._rec is not None:
             from . import recordio
+            if len(self.seq) > self._LABEL_SCAN_LIMIT:
+                import warnings
+                warnings.warn(
+                    f"ImageDetIter: inferring label_shape from the first "
+                    f"{self._LABEL_SCAN_LIMIT} of {len(self.seq)} records; "
+                    "records later in the file with more objects will be "
+                    "truncated at batch time — pass label_shape=(max_objs, "
+                    "width) explicitly for exact bounds", stacklevel=3)
             for key in self.seq[:self._LABEL_SCAN_LIMIT]:
                 header, _ = recordio.unpack(self._rec.read_idx(key))
                 objs = _parse_det_label(header.label)

@@ -621,6 +621,14 @@ def flash_attention(q, k, v, seg_q=None, seg_kv=None, causal=False,
     (B, H, Lq, D) in q's dtype.  ``block_h=0`` auto-picks the head-block
     (largest divisor of H under the VMEM budget).  ``interpret=True`` runs
     the Pallas interpreter (CPU tests).
+
+    Numeric contract: the running max is clamped at -1e4 (``_M_FLOOR``) so
+    masked logits (-1e30) contribute an exact 0.0 without a second where
+    pass.  Consequence: a row whose TRUE max logit is below -1e4 (only
+    reachable with exploding/degenerate logits — |scale*q.k| >= 1e4)
+    underflows entirely and returns zeros with zero grads instead of exact
+    softmax.  Normal-scale inputs (|logits| < 1e4) are unaffected; rows
+    that are fully MASKED also return zeros by design.
     """
     out, _ = _flash_fwd(q, k, v, seg_q, seg_kv, causal, sm_scale,
                         block_q, block_k, block_h, interpret)

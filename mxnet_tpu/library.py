@@ -61,9 +61,12 @@ def load(path, verbose=True):
     try:
         spec.loader.exec_module(mod)
     except Exception:
-        # roll back partial registrations so a fixed library can re-load
+        # roll back partial registrations (both seams) so a fixed library
+        # can re-load without duplicate-registration errors or stale ops
         for op in set(_reg.list_ops()) - before_ops:
             _reg._REGISTRY.pop(op, None)
+        for op in set(_custom.get_all_registered()) - before_custom:
+            _custom._REGISTRY.pop(op, None)
         raise
 
     new_ops = sorted(set(_reg.list_ops()) - before_ops)

@@ -168,7 +168,13 @@ def row_sparse_view(dense_nd, ctx=None, dtype=None):
     reduces ON DEVICE (transfer = one bool per row), only the kept rows
     are gathered (on device).  This is what Embedding(sparse_grad=True)'s
     grad view uses — a (vocab, dim) gradient moves dim*touched floats,
-    not the whole table."""
+    not the whole table.
+
+    Despite the name (kept for the reference's grad-stype API surface),
+    the result is a SNAPSHOT taken at call time, not a live view: the
+    mask/indices are materialized per call and mutations to the returned
+    RowSparseNDArray do NOT flow back into the dense buffer.  Callers on
+    the reference's grad-stype path must re-fetch after each backward."""
     jnp = _jnp()
     gd = dense_nd._data
     mask = _np.asarray(jnp.any(gd != 0,

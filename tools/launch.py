@@ -74,6 +74,15 @@ def _await_workers(procs, timeout):
     return codes
 
 
+def _cpu_device_env(n_devices, base_flags=""):
+    """Env overrides forcing a worker onto n virtual CPU devices."""
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (f"{base_flags} --xla_force_host_platform_device_"
+                      f"count={n_devices}").strip(),
+    }
+
+
 def launch_local(n, command, env_extra=None, cpu_devices_per_worker=None,
                  timeout=600):
     """Spawn n local worker processes; returns their exit codes."""
@@ -87,11 +96,8 @@ def launch_local(n, command, env_extra=None, cpu_devices_per_worker=None,
         env["MXNET_DIST_NUM_WORKERS"] = str(n)
         env["MXNET_DIST_RANK"] = str(rank)
         if cpu_devices_per_worker:
-            env["JAX_PLATFORMS"] = "cpu"
-            flags = env.get("XLA_FLAGS", "")
-            env["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count="
-                f"{cpu_devices_per_worker}").strip()
+            env.update(_cpu_device_env(cpu_devices_per_worker,
+                                       env.get("XLA_FLAGS", "")))
         procs.append(subprocess.Popen(command, env=env))
     return _await_workers(procs, timeout)
 
@@ -171,9 +177,11 @@ def main(argv=None):
         with open(args.hostfile) as f:
             hosts = [s for s in (h.strip() for h in f)
                      if s and not s.startswith("#")]
+        env_extra = _cpu_device_env(args.cpu_devices) \
+            if args.cpu_devices else None
         codes = launch_ssh(args.num_workers, hosts, args.command,
                            port=args.port, timeout=args.timeout,
-                           dry_run=args.dry_run)
+                           env_extra=env_extra, dry_run=args.dry_run)
     else:
         codes = launch_local(args.num_workers, args.command,
                              cpu_devices_per_worker=args.cpu_devices,
