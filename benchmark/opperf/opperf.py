@@ -86,6 +86,20 @@ def _inputs_for(name, mx):
                                  .astype(np.int64)),
              nd.array(r.randint(0, _N, (_N * 4,)).astype(np.int64)),
              t(_N, _N)], {"num_cols": _N}),
+        # r5 additions: Module-era loss heads + im2col/col2im
+        "LinearRegressionOutput": ([t(_N, 10), t(_N, 10)], {}),
+        "MAERegressionOutput": ([t(_N, 10), t(_N, 10)], {}),
+        "LogisticRegressionOutput": (
+            [t(_N, 10), nd.array((r.rand(_N, 10) > 0.5)
+                                 .astype(np.float32))], {}),
+        "center_loss": (
+            [t(_N, 16), nd.array(r.randint(0, 8, (_N,)).astype(np.float32)),
+             t(8, 16)], {}),
+        "im2col": ([t(8, 16, 32, 32)],
+                   {"kernel": (3, 3), "stride": (1, 1), "pad": (1, 1)}),
+        "col2im": ([t(8, 16 * 9, 32 * 32)],
+                   {"output_size": (32, 32), "kernel": (3, 3),
+                    "stride": (1, 1), "pad": (1, 1)}),
     }
     if name in overrides:
         return overrides[name]

@@ -722,3 +722,142 @@ _wire_inputs("RNN", ("data", "parameters", "state", "state_cell"),
              omit=lambda attrs: set()
              if attrs.get("mode", "lstm") == "lstm" else {"state_cell"})
 _wire_inputs("SoftmaxOutput", ("data", "label"))
+
+
+# -- Module-era loss heads (reference src/operator/regression_output.*,
+# svm_output.*, center_loss — SURVEY §2.2 misc top-level) -------------------
+#
+# All three regression heads share the reference contract: forward is the
+# prediction (identity / sigmoid), backward is the LOSS gradient
+# BackwardOp(out, label) * grad_scale / num_output injected via custom_vjp
+# (the head IS the loss — incoming out-grad is ignored), where num_output
+# is the per-sample output count (reference regression_output-inl.h divides
+# the gradient by data.Size()/batch).
+
+def _regression_head(fwd_fn, bwd_fn):
+    import jax
+    jnp = _jnp()
+
+    def head(data, label, grad_scale=1.0):
+        @jax.custom_vjp
+        def f(d, l):
+            return fwd_fn(d)
+
+        def f_fwd(d, l):
+            out = fwd_fn(d)
+            return out, (out, d, l)
+
+        def f_bwd(res, g):  # noqa: ARG001 — loss head, out-grad ignored
+            out, d, l = res
+            num_output = max(int(_np.prod(d.shape[1:])), 1) if d.ndim > 1 \
+                else 1
+            grad = bwd_fn(out, l.reshape(d.shape).astype(out.dtype))
+            return (grad * (grad_scale / num_output)).astype(d.dtype), \
+                jnp.zeros_like(l)
+
+        f.defvjp(f_fwd, f_bwd)
+        return f(data, label)
+    return head
+
+
+@register("LinearRegressionOutput")
+def _linear_regression_output(data, label, grad_scale=1.0):
+    """L2 head: forward = identity, grad = (out - label).
+    reference src/operator/regression_output.cc (LinearRegressionOutput)."""
+    return _regression_head(lambda d: d, lambda o, l: o - l)(
+        data, label, grad_scale)
+
+
+@register("MAERegressionOutput")
+def _mae_regression_output(data, label, grad_scale=1.0):
+    """L1 head: forward = identity, grad = sign(out - label).
+    reference src/operator/regression_output.cc (MAERegressionOutput)."""
+    jnp = _jnp()
+    return _regression_head(lambda d: d, lambda o, l: jnp.sign(o - l))(
+        data, label, grad_scale)
+
+
+@register("LogisticRegressionOutput")
+def _logistic_regression_output(data, label, grad_scale=1.0):
+    """Sigmoid CE head: forward = sigmoid, grad = (sigmoid(out) - label)
+    (the cross-entropy-through-sigmoid gradient).
+    reference src/operator/regression_output.cc (LogisticRegressionOutput)."""
+    import jax
+    return _regression_head(jax.nn.sigmoid, lambda o, l: o - l)(
+        data, label, grad_scale)
+
+
+@register("center_loss", num_outputs=2, visible_outputs=1,
+          mutate_inputs=((1, 2),), wrap_train="_training")
+def _center_loss(data, label, center, grad_scale=1.0, alpha=0.1,
+                 _training=False):
+    """Center loss (SURVEY §2.2 misc `center_loss`): per-sample
+    0.5*||f_i - c_{y_i}||^2 * grad_scale.  The class centers are an AUX
+    state (BatchNorm-style mutate-input): during training each touched
+    center moves toward its class mean, c_j += alpha * sum(diff_j)/(1+n_j)
+    — centers take NO loss gradient (stop_gradient), matching the
+    reference's update-rule-not-SGD contract."""
+    import jax
+    jnp = _jnp()
+    li = label.astype(jnp.int32).reshape(-1)
+    c = jax.lax.stop_gradient(center)
+    diff = data - c[li]                                    # (B, D)
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1) * grad_scale
+    if _training:
+        n = jnp.zeros((center.shape[0],), data.dtype).at[li].add(1.0)
+        s = jnp.zeros_like(c).at[li].add(diff)
+        new_center = c + alpha * s / (1.0 + n)[:, None]
+    else:
+        new_center = c
+    return loss, new_center.astype(center.dtype)
+
+
+def _im2col_patches(data, kernel, stride, dilate, pad):
+    import jax
+    nspatial = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nspatial
+    dilate = tuple(dilate) if dilate else (1,) * nspatial
+    pad = tuple(pad) if pad else (0,) * nspatial
+    # conv_general_dilated_patches emits channel-major patch channels
+    # (c, k1, k2, ...) — the reference im2col.h layout
+    spec = "NCHW" if nspatial == 2 else ("NCW" if nspatial == 1 else "NCDHW")
+    out = jax.lax.conv_general_dilated_patches(
+        data, filter_shape=kernel,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=(spec, spec.replace("N", "O").replace("C", "I"),
+                           spec))
+    n, pc = out.shape[0], out.shape[1]
+    return out.reshape(n, pc, -1)
+
+
+@register("im2col")
+def _im2col(data, kernel, stride=(), dilate=(), pad=()):
+    """Unfold conv patches to a (N, C*prod(kernel), n_locations) matrix —
+    reference src/operator/nn/im2col.h (the lowering both conv paths
+    share upstream; first-class op here, XLA owns the conv lowering)."""
+    return _im2col_patches(data, tuple(kernel), stride, dilate, pad)
+
+
+@register("col2im")
+def _col2im(data, output_size, kernel, stride=(), dilate=(), pad=()):
+    """Fold a column matrix back to an image, scatter-ADDING overlapping
+    patches — exactly im2col's transpose, so it is computed as im2col's
+    VJP (reference src/operator/nn/im2col.h col2im)."""
+    import jax
+    jnp = _jnp()
+    kernel = tuple(kernel)
+    spatial = tuple(output_size)
+    n = data.shape[0]
+    c = data.shape[1] // int(_np.prod(kernel))
+    ref = jnp.zeros((n, c) + spatial, data.dtype)
+    _, vjp = jax.vjp(
+        lambda x: _im2col_patches(x, kernel, stride, dilate, pad), ref)
+    return vjp(data)[0]
+
+
+_wire_inputs("LinearRegressionOutput", ("data", "label"))
+_wire_inputs("MAERegressionOutput", ("data", "label"))
+_wire_inputs("LogisticRegressionOutput", ("data", "label"))
+_wire_inputs("center_loss", ("data", "label", "center"), aux=("center",))

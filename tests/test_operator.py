@@ -315,3 +315,110 @@ def test_boolean_mask():
     mask = np.array([1, 0, 1, 0, 1], "float32")
     out = nd.boolean_mask(nd.array(x), nd.array(mask))
     assert_almost_equal(out.asnumpy(), x[[0, 2, 4]])
+
+
+# -- r5 operator tail: regression heads, center_loss, im2col/col2im --------
+
+def test_regression_output_heads():
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(0)
+    d = rng.randn(4, 3).astype("float32")
+    l = rng.randn(4, 3).astype("float32")
+
+    x = nd.array(d); x.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(x, nd.array(l), grad_scale=2.0)
+    out.backward()
+    assert_almost_equal(out.asnumpy(), d)
+    assert_almost_equal(x.grad.asnumpy(), (d - l) * 2.0 / 3, rtol=1e-5)
+
+    x = nd.array(d); x.attach_grad()
+    with autograd.record():
+        out = nd.MAERegressionOutput(x, nd.array(l))
+    out.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.sign(d - l) / 3, rtol=1e-5)
+
+    lb = (rng.rand(4, 3) > 0.5).astype("float32")
+    x = nd.array(d); x.attach_grad()
+    with autograd.record():
+        out = nd.LogisticRegressionOutput(x, nd.array(lb))
+    out.backward()
+    sig = 1 / (1 + np.exp(-d))
+    assert_almost_equal(out.asnumpy(), sig, rtol=1e-5)
+    assert_almost_equal(x.grad.asnumpy(), (sig - lb) / 3, rtol=1e-5)
+
+
+def test_regression_output_module_fit():
+    """Module-era workflow: LinearRegressionOutput head learns a linear
+    map under Module.fit (reference model.py usage of the heads)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 8).astype("float32")
+    W = rng.randn(8, 1).astype("float32")
+    y = (X @ W).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=True,
+                           label_name="lin_label")
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    net = mx.sym.LinearRegressionOutput(net, name="lin")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("lin_label",))
+    mod.fit(it, num_epoch=10, optimizer="adam",
+            optimizer_params=(("learning_rate", 0.05),),
+            eval_metric="mse")
+    mse = mod.score(it, "mse")[0][1]
+    assert mse < 0.05, f"LinearRegressionOutput failed to learn (mse={mse})"
+
+
+def test_center_loss():
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(0)
+    f = rng.randn(6, 4).astype("float32")
+    y = rng.randint(0, 3, (6,)).astype("float32")
+    c0 = rng.randn(3, 4).astype("float32")
+
+    x = nd.array(f); x.attach_grad()
+    centers = nd.array(c0.copy())
+    with autograd.record():
+        loss = nd.center_loss(x, nd.array(y), centers, grad_scale=1.0,
+                              alpha=0.5)
+    loss.backward()
+    diff = f - c0[y.astype(int)]
+    assert_almost_equal(loss.asnumpy(),
+                        0.5 * (diff ** 2).sum(axis=1), rtol=1e-5)
+    # loss gradient flows to features only (centers are aux state)
+    assert_almost_equal(x.grad.asnumpy(), diff, rtol=1e-5)
+    # aux update: c_j += alpha * sum(diff_j) / (1 + n_j), training mode only
+    cn = centers.asnumpy()
+    expect = c0.copy()
+    for j in range(3):
+        sel = y.astype(int) == j
+        expect[j] += 0.5 * diff[sel].sum(axis=0) / (1 + sel.sum())
+    assert_almost_equal(cn, expect, rtol=1e-5)
+    # inference mode: centers stay put
+    centers2 = nd.array(c0.copy())
+    nd.center_loss(nd.array(f), nd.array(y), centers2, alpha=0.5)
+    assert_almost_equal(centers2.asnumpy(), c0)
+
+
+def test_im2col_col2im():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+    out = nd.im2col(nd.array(x), kernel=(3, 3), stride=(1, 1), pad=(1, 1))
+    assert out.shape == (2, 27, 25)
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    cols = np.zeros((2, 3, 3, 3, 5, 5), np.float32)
+    for kh in range(3):
+        for kw in range(3):
+            cols[:, :, kh, kw] = xp[:, :, kh:kh + 5, kw:kw + 5]
+    assert_almost_equal(out.asnumpy(), cols.reshape(2, 27, 25))
+    # col2im is im2col's transpose: scatter-adds overlapping patches; a
+    # ones-column image counts how many patches cover each pixel
+    ones = nd.array(np.ones((1, 9, 25), np.float32))
+    cover = nd.col2im(ones, output_size=(5, 5), kernel=(3, 3),
+                      stride=(1, 1), pad=(1, 1)).asnumpy()
+    assert cover[0, 0, 2, 2] == 9.0 and cover[0, 0, 0, 0] == 4.0
+    # kernel=1 roundtrip is exact
+    x1 = rng.randn(2, 3, 4, 4).astype("float32")
+    c1 = nd.im2col(nd.array(x1), kernel=(1, 1))
+    assert_almost_equal(
+        nd.col2im(c1, output_size=(4, 4), kernel=(1, 1)).asnumpy(), x1)
