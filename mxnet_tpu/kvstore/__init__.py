@@ -48,9 +48,16 @@ def create(name="local", **kwargs):
             "rebuild: asynchronous parameter-server SGD has no TPU-native "
             "equivalent (no server processes exist; gradients reduce via "
             "synchronous XLA collectives). Use 'dist_tpu_sync'.")
+    # pluggable backends (reference 1.7 KVStoreBase.register — how the
+    # horovod backend plugged in upstream): registered classes resolve by
+    # their class name, after the built-ins so they can't shadow those
+    klass = KVStoreBase.registered(n)
+    if klass is not None:
+        return klass(**kwargs)
     if n == "horovod":
         raise MXNetError("horovod backend not available in this build; use "
-                         "'dist_tpu_sync'")
+                         "'dist_tpu_sync' (or KVStoreBase.register a "
+                         "custom backend class named Horovod)")
     raise MXNetError(f"unknown kvstore type {name!r}")
 
 

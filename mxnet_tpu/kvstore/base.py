@@ -11,8 +11,30 @@ _BACKENDS = {}
 class KVStoreBase:
     @staticmethod
     def register(klass):
-        _BACKENDS[klass.__name__.lower()] = klass
+        """Class decorator: make ``klass`` creatable via
+        ``mx.kv.create(klass.__name__)`` (reference 1.7
+        python/mxnet/kvstore/base.py::KVStoreBase.register — the extension
+        point the horovod backend used upstream).  Case-insensitive; a
+        re-register under the same name replaces the previous class (the
+        reference warns-and-overwrites; notebooks re-run cells)."""
+        name = klass.__name__.lower()
+        prev = _BACKENDS.get(name)
+        if prev is not None and prev is not klass:
+            import warnings
+            warnings.warn(f"KVStore backend {name!r} already registered "
+                          f"({prev.__name__}); overwriting with "
+                          f"{klass.__name__}", stacklevel=2)
+        _BACKENDS[name] = klass
         return klass
+
+    @staticmethod
+    def registered(name):
+        """Look up a registered backend class by type string (or None)."""
+        return _BACKENDS.get(name.lower())
+
+    @staticmethod
+    def list_backends():
+        return sorted(_BACKENDS)
 
     # capability strings (reference KVStoreBase.OPTIMIZER/...)
     OPTIMIZER = "optimizer"

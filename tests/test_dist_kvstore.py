@@ -36,3 +36,50 @@ def test_launch_rejects_servers():
         capture_output=True, text=True)
     assert res.returncode != 0
     assert "no server role" in res.stderr
+
+
+def test_kvstore_backend_registration():
+    """Reference 1.7 KVStoreBase.register: a custom backend class becomes
+    creatable by its class name through mx.kv.create (the extension point
+    the horovod backend used upstream)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.kvstore import KVStoreBase
+
+    @KVStoreBase.register
+    class MyHorovod(KVStoreBase):
+        def __init__(self, scale=1.0):
+            self.scale = scale
+
+        @property
+        def type(self):
+            return "myhorovod"
+
+        def broadcast(self, key, value, out):
+            for o in out if isinstance(out, (list, tuple)) else [out]:
+                o[:] = value
+
+        def pushpull(self, key, value, out=None, priority=0):
+            if out is not None:
+                out[:] = value * self.scale
+            return value
+
+    from mxnet_tpu.kvstore import base as kv_base
+    try:
+        assert "myhorovod" in KVStoreBase.list_backends()
+        kv = mx.kv.create("MyHorovod", scale=2.0)   # case-insensitive
+        assert kv.type == "myhorovod"
+        assert kv.rank == 0 and kv.num_workers == 1
+        v = mx.nd.array(np.ones((3,), np.float32))
+        out = mx.nd.array(np.zeros((3,), np.float32))
+        kv.pushpull("w0", v, out=out)
+        np.testing.assert_allclose(out.asnumpy(), 2.0 * np.ones(3))
+
+        # built-ins are not shadowed by registration
+        class Local(KVStoreBase):
+            pass
+        KVStoreBase.register(Local)
+        assert type(mx.kv.create("local")).__name__ == "KVStoreLocal"
+    finally:
+        kv_base._BACKENDS.pop("myhorovod", None)
+        kv_base._BACKENDS.pop("local", None)
