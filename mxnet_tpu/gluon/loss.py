@@ -11,7 +11,7 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss",
            "SquaredHingeLoss", "LogisticLoss", "TripletLoss",
-           "CosineEmbeddingLoss"]
+           "CosineEmbeddingLoss", "LabelSmoothedCELoss"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -117,6 +117,44 @@ class SoftmaxCrossEntropyLoss(Loss):
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class LabelSmoothedCELoss(Loss):
+    """Label-smoothed softmax CE over sparse int labels — the MT training
+    loss (GluonNLP LabelSmoothing + SoftmaxCEMaskedLoss pair, collapsed
+    into one fused computation: the smoothed target distribution is never
+    materialized).
+
+    loss_i = (1-a) * nll_i + a * mean_v(-logp_i[v]),  a = ``smoothing``.
+    Positions whose label equals ``ignore_index`` (target padding)
+    contribute zero and are excluded from the mean when ``normalize``.
+    Returns per-BATCH-ROW loss like the other losses here (mean over
+    non-batch axes, padding-aware)."""
+
+    def __init__(self, smoothing=0.1, ignore_index=None, axis=-1,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smoothing = smoothing
+        self._ignore = ignore_index
+        self._axis = axis
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        logp = F.log_softmax(pred, axis=self._axis)
+        nll = -F.pick(logp, label, axis=self._axis)        # (B, L...)
+        uniform = -F.mean(logp, axis=self._axis)
+        loss = (1.0 - self._smoothing) * nll + self._smoothing * uniform
+        if self._ignore is not None:
+            axes = tuple(i for i in range(loss.ndim)
+                         if i != self._batch_axis)
+            valid = (label != self._ignore).astype(loss.dtype)
+            loss = _apply_weighting(F, loss * valid, self._weight,
+                                    sample_weight)
+            if not axes:
+                return loss
+            n = F.sum(valid, axis=axes)          # max(count, 1) floor
+            return F.sum(loss, axis=axes) / F.maximum(n, F.ones_like(n))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return self._mean_over_non_batch(F, loss)
 
 
 class KLDivLoss(Loss):

@@ -1,0 +1,249 @@
+"""Transformer-base MT (encoder-decoder) — BASELINE config 3's second half
+("GluonNLP: BERT-base pretrain + Transformer-base MT").
+
+Reference anchors: the attention fast paths consume the fused contrib ops
+mirroring src/operator/contrib/transformer.cc — self-attention via
+``contrib.masked_selfatt`` (interleaved qkv layout) and cross-attention via
+``contrib.masked_encdec_att`` (the encdec qk/valatt chain's fused form);
+the block structure follows GluonNLP's transformer.py (external repo — the
+reference keeps no transformer model in-tree, SURVEY §5.7/§1 L11).
+
+Architecture = Vaswani et al. transformer-base: 6+6 layers, d=512,
+ffn=2048, 8 heads, post-norm, sinusoidal positions, shared target
+embedding / output projection.  TPU-native notes: time-major (L, B, C)
+through the cells (the fused ops' layout contract); the causal decoder
+mask is a static fact (no mask tensors); label smoothing lives in
+``gluon.loss.LabelSmoothedCELoss``.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..block import HybridBlock
+from ..nn import Dense, Dropout, LayerNorm
+
+__all__ = ["TransformerEncoderCell", "TransformerDecoderCell",
+           "TransformerEncoder", "TransformerDecoder", "TransformerModel",
+           "transformer_model", "greedy_decode"]
+
+
+def _positional_encoding(max_len, units):
+    """Sinusoidal position table (transformer-base; no learned table)."""
+    pos = _np.arange(max_len)[:, None]
+    dim = _np.arange(0, units, 2)[None, :]
+    angle = pos / _np.power(10000.0, dim / units)
+    enc = _np.zeros((max_len, units), _np.float32)
+    enc[:, 0::2] = _np.sin(angle)
+    enc[:, 1::2] = _np.cos(angle)
+    return enc
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Post-norm encoder block over the fused self-attention op."""
+
+    def __init__(self, units=512, hidden_size=2048, num_heads=8,
+                 dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._num_heads = num_heads
+        with self.name_scope():
+            self.attn_qkv = Dense(3 * units, flatten=False, in_units=units,
+                                  prefix="attn_qkv_")
+            self.attn_proj = Dense(units, flatten=False, in_units=units,
+                                   prefix="attn_proj_")
+            self.ffn_1 = Dense(hidden_size, flatten=False, in_units=units,
+                               prefix="ffn1_")
+            self.ffn_2 = Dense(units, flatten=False, in_units=hidden_size,
+                               prefix="ffn2_")
+            self.ln_att = LayerNorm(in_channels=units, prefix="ln1_")
+            self.ln_ffn = LayerNorm(in_channels=units, prefix="ln2_")
+            self.drop = Dropout(dropout)
+
+    def hybrid_forward(self, F, x, valid_length=None):
+        qkv = self.attn_qkv(x)                        # (L, B, 3C)
+        ctx = F.contrib.masked_selfatt(qkv, valid_length,
+                                       heads=self._num_heads)
+        out = self.ln_att(x + self.drop(self.attn_proj(ctx)))
+        h = self.ffn_2(F.relu(self.ffn_1(out)))       # base uses ReLU ffn
+        return self.ln_ffn(out + self.drop(h))
+
+
+class TransformerDecoderCell(HybridBlock):
+    """Post-norm decoder block: causal self-attention + fused
+    cross-attention over the encoder memory."""
+
+    def __init__(self, units=512, hidden_size=2048, num_heads=8,
+                 dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._num_heads = num_heads
+        with self.name_scope():
+            self.attn_qkv = Dense(3 * units, flatten=False, in_units=units,
+                                  prefix="self_qkv_")
+            self.attn_proj = Dense(units, flatten=False, in_units=units,
+                                   prefix="self_proj_")
+            self.cross_q = Dense(units, flatten=False, in_units=units,
+                                 prefix="cross_q_")
+            # one fused [k,v] projection of the memory — the encdec layout
+            self.cross_kv = Dense(2 * units, flatten=False, in_units=units,
+                                  prefix="cross_kv_")
+            self.cross_proj = Dense(units, flatten=False, in_units=units,
+                                   prefix="cross_proj_")
+            self.ffn_1 = Dense(hidden_size, flatten=False, in_units=units,
+                               prefix="ffn1_")
+            self.ffn_2 = Dense(units, flatten=False, in_units=hidden_size,
+                               prefix="ffn2_")
+            self.ln_self = LayerNorm(in_channels=units, prefix="ln1_")
+            self.ln_cross = LayerNorm(in_channels=units, prefix="ln2_")
+            self.ln_ffn = LayerNorm(in_channels=units, prefix="ln3_")
+            self.drop = Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mem, mem_valid_length=None):
+        # x (Lt, B, C) target stream; mem (Ls, B, C) encoder output
+        qkv = self.attn_qkv(x)
+        ctx = F.contrib.masked_selfatt(qkv, None, heads=self._num_heads,
+                                       causal=True)
+        out = self.ln_self(x + self.drop(self.attn_proj(ctx)))
+        cross = F.contrib.masked_encdec_att(
+            self.cross_q(out), self.cross_kv(mem), mem_valid_length,
+            heads=self._num_heads)
+        out = self.ln_cross(out + self.drop(self.cross_proj(cross)))
+        h = self.ffn_2(F.relu(self.ffn_1(out)))
+        return self.ln_ffn(out + self.drop(h))
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, num_layers=6, units=512, hidden_size=2048,
+                 num_heads=8, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.cells = []
+        with self.name_scope():
+            for i in range(num_layers):
+                cell = TransformerEncoderCell(units, hidden_size, num_heads,
+                                              dropout, prefix=f"layer{i}_")
+                self.register_child(cell, f"layer{i}")
+                self.cells.append(cell)
+
+    def hybrid_forward(self, F, x, valid_length=None):
+        for cell in self.cells:
+            x = cell(x) if valid_length is None else cell(x, valid_length)
+        return x
+
+
+class TransformerDecoder(HybridBlock):
+    def __init__(self, num_layers=6, units=512, hidden_size=2048,
+                 num_heads=8, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.cells = []
+        with self.name_scope():
+            for i in range(num_layers):
+                cell = TransformerDecoderCell(units, hidden_size, num_heads,
+                                              dropout, prefix=f"layer{i}_")
+                self.register_child(cell, f"layer{i}")
+                self.cells.append(cell)
+
+    def hybrid_forward(self, F, x, mem, mem_valid_length=None):
+        for cell in self.cells:
+            x = cell(x, mem, mem_valid_length)
+        return x
+
+
+class TransformerModel(HybridBlock):
+    """Encoder-decoder MT model.
+
+    ``forward(src_tokens, tgt_tokens[, src_valid_length])`` takes
+    batch-major (B, Ls)/(B, Lt) int tokens (tgt already shifted right by
+    the caller: BOS-prefixed) and returns (B, Lt, V) next-token logits.
+    Source padding is masked via ``src_valid_length`` (B,); target padding
+    is the LOSS's job (label smoothing + padding weight), matching the
+    GluonNLP training contract.
+
+    The token embedding is ONE (vocab, units) table shared by source,
+    target, AND the output softmax projection (the three-way tying of the
+    transformer-base recipe), declared model-level the same way bert.py
+    declares position_weight so the tie survives hybridize/CachedOp.
+    """
+
+    def __init__(self, vocab_size=32768, num_layers=6, units=512,
+                 hidden_size=2048, num_heads=8, max_length=1024,
+                 dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._vocab = vocab_size
+        with self.name_scope():
+            self.embed_weight = self.params.get(
+                "embed_weight", shape=(vocab_size, units), init=None)
+            self.encoder = TransformerEncoder(num_layers, units, hidden_size,
+                                              num_heads, dropout,
+                                              prefix="enc_")
+            self.decoder = TransformerDecoder(num_layers, units, hidden_size,
+                                              num_heads, dropout,
+                                              prefix="dec_")
+            self.drop = Dropout(dropout)
+        self._pos = _positional_encoding(max_length, units)
+
+    def _embed(self, F, weight, tokens):
+        # gather, scale by sqrt(d), add sinusoids (transformer-base recipe)
+        x = F.Embedding(tokens, weight, input_dim=self._vocab,
+                        output_dim=self._units) * float(self._units) ** 0.5
+        pos = F.array(self._pos[:tokens.shape[1]]).astype(x.dtype)
+        x = x + F.expand_dims(pos, axis=0)
+        return F.transpose(self.drop(x), axes=(1, 0, 2))   # (L, B, C)
+
+    def hybrid_forward(self, F, src_tokens, tgt_tokens,
+                       src_valid_length=None, embed_weight=None):
+        mem = self._embed(F, embed_weight, src_tokens)
+        mem = self.encoder(mem) if src_valid_length is None \
+            else self.encoder(mem, src_valid_length)
+        y = self._embed(F, embed_weight, tgt_tokens)
+        y = self.decoder(y, mem, src_valid_length)
+        y = F.transpose(y, axes=(1, 0, 2))                 # (B, Lt, C)
+        # tied output projection: logits = y @ embed^T
+        logits = F.dot(y.reshape((-1, self._units)), embed_weight,
+                       transpose_b=True)
+        return logits.reshape((tgt_tokens.shape[0], tgt_tokens.shape[1], -1))
+
+
+_CONFIGS = {
+    # name: (layers, units, hidden, heads)
+    "transformer_base": (6, 512, 2048, 8),
+    "transformer_big": (6, 1024, 4096, 16),
+    "transformer_test": (2, 64, 128, 4),     # tiny (unit tests)
+}
+
+
+def transformer_model(name="transformer_base", vocab_size=32768,
+                      max_length=1024, dropout=0.1, **kwargs):
+    if name not in _CONFIGS:
+        raise ValueError(f"unknown transformer config {name!r}; "
+                         f"known {sorted(_CONFIGS)}")
+    L, U, H, A = _CONFIGS[name]
+    return TransformerModel(vocab_size=vocab_size, num_layers=L, units=U,
+                            hidden_size=H, num_heads=A,
+                            max_length=max_length, dropout=dropout, **kwargs)
+
+
+def greedy_decode(model, src_tokens, bos_id, eos_id, max_len=64,
+                  src_valid_length=None):
+    """Greedy autoregressive decode: argmax next token until EOS/max_len.
+
+    Re-runs the decoder over the growing prefix each step (O(L^2) total —
+    the example/eval path; production serving would cache k/v).  Returns
+    (B, <=max_len) int32 including BOS, stopping early only when EVERY
+    sequence has emitted EOS.
+    """
+    import numpy as np
+    from ... import ndarray as mxnd
+    B = src_tokens.shape[0]
+    tgt = np.full((B, 1), bos_id, np.int32)
+    done = np.zeros((B,), bool)
+    for _ in range(max_len - 1):
+        logits = model(src_tokens, mxnd.array(tgt),
+                       src_valid_length) if src_valid_length is not None \
+            else model(src_tokens, mxnd.array(tgt))
+        nxt = np.asarray(logits.asnumpy()[:, -1].argmax(-1), np.int32)
+        nxt = np.where(done, eos_id, nxt)
+        tgt = np.concatenate([tgt, nxt[:, None]], axis=1)
+        done |= nxt == eos_id
+        if done.all():
+            break
+    return tgt

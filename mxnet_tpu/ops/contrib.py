@@ -327,6 +327,73 @@ def _interleaved_matmul_encdec_valatt(kv, att, heads=1):
     return out.reshape(Lq, B, -1)
 
 
+@register("contrib.masked_encdec_att")
+def _masked_encdec_att(q, kv, valid_length=None, heads=1):
+    """Fused masked encoder-decoder (cross) attention — the single-op TPU
+    replacement for the reference's interleaved_matmul_encdec_qk →
+    (mask) → softmax → interleaved_matmul_encdec_valatt chain
+    (src/operator/contrib/transformer.cc encdec variants; GluonNLP's
+    transformer decoder applies the source valid_length mask between qk
+    and softmax).
+
+    Layout contract matches the unfused pair above: ``q`` is (Lq, B,
+    heads*D) decoder queries; ``kv`` is (Lk, B, 2*heads*D) with per-head
+    [k, v] interleaving from one fused projection of the encoder output;
+    ``valid_length`` (B,) masks encoder PADDING keys (queries are always
+    valid — target padding is handled by the loss).  Returns (Lq, B,
+    heads*D).
+
+    On TPU this lowers to the Pallas flash kernel, which supports
+    Lq != Lk (cross-lengths are parity-tested) — padding rides the
+    kernel's separate seg_q/seg_kv inputs so no (Lq, Lk) mask tensor is
+    ever materialized.
+    """
+    import jax
+    jnp = _jnp()
+    Lq, B, E = q.shape
+    D = E // heads
+    Lk = kv.shape[0]
+    qh = jnp.transpose(q.reshape(Lq, B, heads, D), (1, 2, 0, 3))
+    kvh = kv.reshape(Lk, B, heads, 2, D)
+    kh = jnp.transpose(kvh[:, :, :, 0], (1, 2, 0, 3))    # (B, H, Lk, D)
+    vh = jnp.transpose(kvh[:, :, :, 1], (1, 2, 0, 3))
+    scale = 1.0 / float(D) ** 0.5
+    if valid_length is None:
+        seg_q = seg_kv = None
+    else:
+        steps = jnp.arange(Lk, dtype=jnp.int32)
+        seg_kv = (steps[None, :] < valid_length.astype(jnp.int32)[:, None]) \
+            .astype(jnp.int32)                            # (B, Lk)
+        seg_q = jnp.ones((B, Lq), jnp.int32)              # queries all valid
+    if _flash_eligible(Lq, D) and _flash_eligible(Lk, D):
+        from ..kernels.flash_attention import flash_attention
+
+        def _tpu(qh, kh, vh):
+            return flash_attention(qh, kh, vh, seg_q, seg_kv, False, scale)
+
+        def _portable(qh, kh, vh):
+            return _dense_sdpa_cross(qh, kh, vh, seg_kv, scale)
+
+        out = jax.lax.platform_dependent(qh, kh, vh,
+                                         tpu=_tpu, default=_portable)
+    else:
+        out = _dense_sdpa_cross(qh, kh, vh, seg_kv, scale)
+    return jnp.transpose(out, (2, 0, 1, 3)).reshape(Lq, B, E)
+
+
+def _dense_sdpa_cross(q, k, v, seg_kv, scale):
+    """Cross-attention dense fallback: only KEY positions are masked
+    (seg_kv (B, Lk); None = all valid), fp32 softmax."""
+    import jax
+    jnp = _jnp()
+    att = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if seg_kv is not None:
+        att = jnp.where((seg_kv > 0)[:, None, None, :], att,
+                        jnp.asarray(-1e9, jnp.float32))
+    p = jax.nn.softmax(att, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
 @register("contrib.arange_like", differentiable=False)
 def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
     jnp = _jnp()

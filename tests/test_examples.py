@@ -132,3 +132,17 @@ def test_launch_ssh_command_construction():
     # dry-run path prints and reports success without spawning
     codes = launch.launch_ssh(2, ["h1"], ["echo", "hi"], dry_run=True)
     assert codes == [0, 0]
+
+
+def test_transformer_mt_learns():
+    mod = _load("transformer_mt/train_mt.py")
+    rec = mod.run(vocab=24, layers=1, units=32, hidden=64, heads=2,
+                  batch=8, steps=30, lr=3e-3, warmup=10, log=False,
+                  decode_samples=2)
+    assert rec["last_loss"] < rec["first_loss"]
+
+
+def test_yolo3_trains_and_detects():
+    mod = _load("yolo/train_yolo.py")
+    rec = mod.run(batch=8, steps=25, log=False)
+    assert rec["last_loss"] < rec["first_loss"]

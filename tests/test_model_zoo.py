@@ -82,3 +82,97 @@ def test_hybridize_parity_resnet(seeded):
     net.hybridize()
     hyb = net(x).asnumpy()
     np.testing.assert_allclose(imp, hyb, rtol=1e-4, atol=1e-5)
+
+
+# -- r5: Transformer-base MT (BASELINE config 3) + YOLOv3 (config 2) -------
+
+def test_transformer_causality_and_enc_mask(seeded):
+    from mxnet_tpu.gluon.model_zoo import transformer
+    m = transformer.transformer_model("transformer_test", vocab_size=50,
+                                      max_length=32, dropout=0.0)
+    m.initialize(mx.initializer.Normal(0.05))
+    r = np.random.RandomState(0)
+    src = mx.nd.array(r.randint(0, 50, (3, 10)).astype(np.int32))
+    tgt = mx.nd.array(r.randint(0, 50, (3, 8)).astype(np.int32))
+    vl = mx.nd.array(np.array([10, 7, 4], np.int32))
+    logits = m(src, tgt, vl)
+    assert logits.shape == (3, 8, 50)
+    # decoder causality: perturbing tgt[:, 5] leaves logits[:, :5] unchanged
+    t2 = tgt.asnumpy().copy()
+    t2[:, 5] = (t2[:, 5] + 1) % 50
+    l2 = m(src, mx.nd.array(t2), vl)
+    d = np.abs(logits.asnumpy() - l2.asnumpy()).max(axis=(0, 2))
+    np.testing.assert_allclose(d[:5], 0, atol=1e-5)
+    assert d[5:].max() > 1e-3
+    # encoder padding mask: tokens beyond valid_length are invisible
+    s2 = src.asnumpy().copy()
+    s2[1, 8] = (s2[1, 8] + 3) % 50      # beyond vl=7
+    l3 = m(mx.nd.array(s2), tgt, vl)
+    np.testing.assert_allclose(logits.asnumpy(), l3.asnumpy(), atol=1e-5)
+
+
+def test_transformer_tied_embedding(seeded):
+    from mxnet_tpu.gluon.model_zoo import transformer
+    m = transformer.transformer_model("transformer_test", vocab_size=30,
+                                      max_length=16)
+    params = m.collect_params()
+    embeds = [k for k in params.keys() if "embed_weight" in k]
+    assert len(embeds) == 1          # one table: src = tgt = softmax
+
+
+def test_label_smoothed_ce_loss():
+    from mxnet_tpu.gluon.loss import LabelSmoothedCELoss
+    r = np.random.RandomState(0)
+    logits = mx.nd.array(r.randn(4, 6, 10).astype(np.float32))
+    labels = np.array(r.randint(1, 10, (4, 6)), np.float32)
+    labels[0, 3:] = 0                # padding
+    # smoothing=0 + no padding == plain softmax CE
+    plain = LabelSmoothedCELoss(smoothing=0.0)(
+        logits, mx.nd.array(labels)).asnumpy()
+    logp = logits.asnumpy() - np.log(
+        np.exp(logits.asnumpy()).sum(-1, keepdims=True))
+    nll = np.take_along_axis(
+        logp, labels.astype(int)[..., None], -1)[..., 0]
+    np.testing.assert_allclose(plain, -nll.mean(-1), rtol=1e-4, atol=1e-5)
+    # padding rows contribute zero under ignore_index
+    l_pad = LabelSmoothedCELoss(smoothing=0.1, ignore_index=0)(
+        logits, mx.nd.array(labels)).asnumpy()
+    sm = 0.9 * (-nll) + 0.1 * (-logp.mean(-1))
+    want0 = sm[0, :3].sum() / 3      # only the 3 valid positions
+    np.testing.assert_allclose(l_pad[0], want0, rtol=1e-4, atol=1e-5)
+
+
+def test_yolo3_structure_and_targets(seeded):
+    from mxnet_tpu.gluon.model_zoo import yolo
+    net = yolo.YOLOV3(
+        backbone=yolo.Darknet(layers=(1, 1, 1, 1, 1),
+                              channels=(4, 8, 16, 32, 64, 128)),
+        classes=3, channels=(32, 16, 8))
+    net.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(np.random.RandomState(0)
+                    .randn(2, 3, 64, 64).astype(np.float32))
+    outs = net(x)
+    # strides 32/16/8 on a 64px input, 3 anchors each, 5+classes channels
+    assert [tuple(o.shape) for o in outs] == \
+        [(2, 2 * 2 * 3, 8), (2, 4 * 4 * 3, 8), (2, 8 * 8 * 3, 8)]
+    gen = yolo.YOLOV3TargetGenerator(classes=3, input_size=64)
+    labels = np.array([[[1, .1, .1, .5, .5], [-1, 0, 0, 0, 0]],
+                       [[2, .3, .2, .9, .8], [0, 0, 0, .2, .3]]],
+                      np.float32)
+    targets = gen(labels)
+    # every non-padding gt claims exactly one positive anchor
+    n_pos = sum(t[4].sum() for t in targets)
+    assert n_pos == 3
+    loss = yolo.YOLOV3Loss()(
+        mx.nd, outs, [[mx.nd.array(t) for t in s] for s in targets])
+    assert np.isfinite(float(loss.asnumpy()))
+    det = yolo.yolo3_decode(outs, input_size=64, conf_thresh=0.0, topk=5)
+    assert det.shape == (2, 5, 6)
+
+
+def test_yolo3_darknet53_constructs():
+    from mxnet_tpu.gluon.model_zoo import yolo
+    net = yolo.yolo3_darknet53(classes=80)
+    n_convs = sum(1 for k in net.collect_params().keys()
+                  if "conv" in k and k.endswith("weight"))
+    assert n_convs >= 52 + 3        # darknet53 + heads
