@@ -379,15 +379,42 @@ class LibSVMIter(DataIter):
 
 def _decode_record(raw, cfg):
     """Decode + augment one packed image record (pure function so it runs
-    in thread OR process workers — reference ParseChunk body)."""
-    import cv2
+    in thread OR process workers — reference ParseChunk body).
+
+    Fast lane: when the native fused decoder is available (src/
+    jpeg_decode.cc — the reference's ParseChunk/libjpeg-turbo role) and no
+    resize stage is configured, decode + crop + mirror + normalize happen
+    in ONE C pass with no intermediate full-size float image.  Pixel
+    values differ from the cv2 path by <= ~4/255 (libjpeg IFAST DCT +
+    plain chroma upsampling — augmentation-level noise, same tradeoff the
+    reference makes).  Non-JPEG payloads and undersized images fall back
+    to the generic path."""
     from .. import recordio as rio
     header, img_bytes = rio.unpack(raw)
+    c, h, w = cfg["data_shape"]
+    resize = cfg["resize"]
+    label = header.label if _np.isscalar(header.label) \
+        else _np.asarray(header.label).ravel()[0]
+    if c == 3 and resize <= 0 and cfg.get("native", True):
+        from .. import native
+        dims = native.jpeg_dims(img_bytes)
+        if dims is not None and dims[0] >= w and dims[1] >= h:
+            iw, ih = dims
+            if cfg["rand_crop"]:
+                x0 = _np.random.randint(0, iw - w + 1)
+                y0 = _np.random.randint(0, ih - h + 1)
+            else:
+                x0, y0 = (iw - w) // 2, (ih - h) // 2
+            mirror = bool(cfg["rand_mirror"]) and _np.random.rand() < 0.5
+            out = native.jpeg_decode_crop_norm(
+                img_bytes, (h, w), crop_xy=(x0, y0), mirror=mirror,
+                mean=cfg["mean"], std=cfg["std"])
+            if out is not None:
+                return out, _np.float32(label)
+    import cv2   # only the fallback path needs opencv
     img = cv2.imdecode(_np.frombuffer(img_bytes, _np.uint8),
                        cv2.IMREAD_COLOR)
     img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
-    c, h, w = cfg["data_shape"]
-    resize = cfg["resize"]
     if resize > 0:
         ih, iw = img.shape[:2]
         if ih < iw:
@@ -408,8 +435,6 @@ def _decode_record(raw, cfg):
         img = img[:, ::-1]
     img = img.astype(_np.float32)
     img = (img - cfg["mean"]) / cfg["std"]
-    label = header.label if _np.isscalar(header.label) \
-        else _np.asarray(header.label).ravel()[0]
     return img.transpose(2, 0, 1), _np.float32(label)
 
 

@@ -50,13 +50,14 @@ def _so_candidates():
     yield os.path.join(cache, "librecordio.so")
 
 
-def _compile(out_path):
+def _compile(out_path, src=_SRC, extra_link=()):
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     # compile to a unique temp name, then atomically rename: concurrent
     # workers (tools/launch.py spawns N processes) must never CDLL a
     # half-written ELF
     tmp = f"{out_path}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src,
+           *extra_link]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, out_path)
@@ -68,11 +69,11 @@ def _compile(out_path):
                 pass
 
 
-def _fresh(so_path):
+def _fresh(so_path, src=_SRC):
     """A prebuilt .so is reusable only if at least as new as the source —
     a stale binary would silently keep old scanner behavior after a fix."""
     try:
-        return os.path.getmtime(so_path) >= os.path.getmtime(_SRC)
+        return os.path.getmtime(so_path) >= os.path.getmtime(src)
     except OSError:
         return False
 
@@ -195,3 +196,106 @@ def read_recordio_batch(path, offsets, lengths):
         res.append(out[pos:pos + int(ln)].tobytes())
         pos += int(ln)
     return res
+
+
+# --------------------------------------------------------------------------
+# Native fused JPEG decode (src/jpeg_decode.cc): decode + scaled IDCT +
+# crop + mirror + normalize in ONE C pass — the reference's
+# iter_image_recordio_2.cc ParseChunk role (libjpeg-turbo scaled decode).
+# --------------------------------------------------------------------------
+
+_JPEG_SRC = os.path.join(os.path.dirname(_SRC), "jpeg_decode.cc")
+_jpeg_lib = None
+_jpeg_tried = False
+
+
+def _jpeg_so_candidates():
+    yield os.path.join(os.path.dirname(_JPEG_SRC), "libjpegdec.so")
+    cache = os.environ.get(
+        "MXNET_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu"))
+    yield os.path.join(cache, "libjpegdec.so")
+
+
+def _bind_jpeg(path):
+    lib = ctypes.CDLL(path)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i32p = ctypes.POINTER(ctypes.c_int)
+    lib.jpg_dims.argtypes = [u8p, ctypes.c_uint64, i32p, i32p]
+    lib.jpg_dims.restype = ctypes.c_int
+    lib.jpg_decode_crop_norm.argtypes = [
+        u8p, ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, f32p, f32p, f32p]
+    lib.jpg_decode_crop_norm.restype = ctypes.c_int
+    return lib
+
+
+def jpeg_lib():
+    """The bound native jpeg decoder, building on first use; None when
+    unavailable (no toolchain / no libjpeg / MXNET_USE_NATIVE=0)."""
+    global _jpeg_lib, _jpeg_tried
+    if _jpeg_lib is not None or _jpeg_tried:
+        return _jpeg_lib
+    with _lock:
+        if _jpeg_lib is not None or _jpeg_tried:
+            return _jpeg_lib
+        _jpeg_tried = True
+        if os.environ.get("MXNET_USE_NATIVE", "1") == "0":
+            return None
+        for cand in _jpeg_so_candidates():
+            try:
+                if not (os.path.exists(cand) and _fresh(cand, _JPEG_SRC)):
+                    _compile(cand, src=_JPEG_SRC, extra_link=("-ljpeg",))
+                _jpeg_lib = _bind_jpeg(cand)
+                return _jpeg_lib
+            except Exception:  # noqa: BLE001
+                continue
+        return None
+
+
+def jpeg_decode_available():
+    return jpeg_lib() is not None
+
+
+def jpeg_dims(buf):
+    """(width, height) from the JPEG header without decoding, or None."""
+    lib = jpeg_lib()
+    if lib is None:
+        return None
+    arr = _np.frombuffer(buf, _np.uint8)
+    w, h = ctypes.c_int(), ctypes.c_int()
+    rc = lib.jpg_dims(arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                      len(arr), ctypes.byref(w), ctypes.byref(h))
+    if rc != 0:
+        return None
+    return w.value, h.value
+
+
+def jpeg_decode_crop_norm(buf, crop_hw, crop_xy=None, mirror=False,
+                          min_side=0, mean=(0.0, 0.0, 0.0),
+                          std=(1.0, 1.0, 1.0), out=None):
+    """Fused decode+crop+normalize -> float32 CHW ndarray (or writes into
+    ``out``).  Returns None when the native decoder is unavailable or the
+    (possibly IDCT-scaled) image cannot cover the crop — the caller falls
+    back to its generic decode+resize path."""
+    lib = jpeg_lib()
+    if lib is None:
+        return None
+    h, w = crop_hw
+    arr = _np.frombuffer(buf, _np.uint8)
+    if out is None:
+        out = _np.empty((3, h, w), _np.float32)
+    mean_a = _np.ascontiguousarray(mean, _np.float32)
+    stdi_a = 1.0 / _np.ascontiguousarray(std, _np.float32)
+    x, y = (-1, -1) if crop_xy is None else (int(crop_xy[0]),
+                                             int(crop_xy[1]))
+    f32p = ctypes.POINTER(ctypes.c_float)
+    rc = lib.jpg_decode_crop_norm(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(arr),
+        w, h, x, y, int(bool(mirror)), int(min_side),
+        mean_a.ctypes.data_as(f32p), stdi_a.ctypes.data_as(f32p),
+        out.ctypes.data_as(f32p))
+    if rc != 0:
+        return None
+    return out

@@ -1,0 +1,163 @@
+// Native fused JPEG decode + crop + mirror + normalize (the reference keeps
+// this hot path in C++: src/io/iter_image_recordio_2.cc ParseChunk decoding
+// with libjpeg-turbo, incl. its scaled-decode trick).  One C call takes raw
+// JPEG bytes and writes a normalized float32 CHW crop into a caller buffer:
+// no intermediate full-size RGB float image, no second normalization pass.
+//
+// Scaled decode: libjpeg's scale_num/8 IDCT sizes (8/8, 4/8, 2/8, 1/8) —
+// the decoder picks the SMALLEST scale whose output still covers the
+// requested crop (+ optional shorter-side resize target), which skips most
+// of the IDCT work for large photos (the libjpeg-turbo trick the reference
+// uses; SURVEY N19 §3.5).
+//
+// C ABI only (ctypes via mxnet_tpu/native.py) — no pybind11 in this build.
+
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int kOk = 0;
+constexpr int kErrDecode = -1;
+constexpr int kErrTooSmall = -2;   // decoded image smaller than the crop
+constexpr int kErrArgs = -3;
+
+struct ErrMgr {
+  jpeg_error_mgr base;
+  std::jmp_buf jump;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  ErrMgr* mgr = reinterpret_cast<ErrMgr*>(cinfo->err);
+  std::longjmp(mgr->jump, 1);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Peek JPEG dimensions without decoding (header parse only).
+int jpg_dims(const uint8_t* buf, uint64_t len, int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr err;
+  cinfo.err = jpeg_std_error(&err.base);
+  err.base.error_exit = error_exit;
+  if (setjmp(err.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return kErrDecode;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  *w = static_cast<int>(cinfo.image_width);
+  *h = static_cast<int>(cinfo.image_height);
+  jpeg_destroy_decompress(&cinfo);
+  return kOk;
+}
+
+// Decode + random-crop + optional mirror + normalize into out (float32,
+// CHW, crop_h x crop_w).  mean/std are per-channel RGB.  crop_x/crop_y are
+// the top-left corner IN DECODED coordinates; pass -1 for center crop.
+//
+// min_side <= 0 (no resize stage): the image decodes at FULL resolution —
+// the crop must sample the original pixels or the random-crop augmentation
+// silently becomes a whole-image downscale.  min_side > 0 (the caller has
+// a shorter-side resize target): the IDCT may scale down as long as the
+// SHORTER decoded side stays >= min_side AND both dims still cover the
+// crop — skipping the IDCT work the resize would throw away (the
+// libjpeg-turbo scaled-decode trick).  Returns kOk, or kErrTooSmall if
+// the image can't cover the crop (caller falls back to its resize path).
+int jpg_decode_crop_norm(const uint8_t* buf, uint64_t len,
+                         int crop_w, int crop_h, int crop_x, int crop_y,
+                         int mirror, int min_side,
+                         const float* mean, const float* std_inv,
+                         float* out) {
+  if (!buf || !out || crop_w <= 0 || crop_h <= 0) return kErrArgs;
+  jpeg_decompress_struct cinfo;
+  ErrMgr err;
+  cinfo.err = jpeg_std_error(&err.base);
+  err.base.error_exit = error_exit;
+  std::vector<uint8_t> row;      // declared before setjmp target use
+  if (setjmp(err.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return kErrDecode;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+
+  // pick the smallest IDCT scale (8..1)/8 honoring the contract above
+  int scale = 8;
+  if (min_side > 0) {
+    for (int s = 1; s <= 8; ++s) {
+      const long sw = (static_cast<long>(cinfo.image_width) * s + 7) / 8;
+      const long sh = (static_cast<long>(cinfo.image_height) * s + 7) / 8;
+      if (std::min(sw, sh) >= min_side && sw >= crop_w && sh >= crop_h) {
+        scale = s;
+        break;
+      }
+    }
+  }
+  cinfo.scale_num = scale;
+  cinfo.scale_denom = 8;
+  cinfo.out_color_space = JCS_RGB;
+  // speed over the last 0.1% of fidelity (the reference's decode params)
+  cinfo.dct_method = JDCT_IFAST;
+  cinfo.do_fancy_upsampling = FALSE;
+  jpeg_start_decompress(&cinfo);
+
+  const int W = static_cast<int>(cinfo.output_width);
+  const int H = static_cast<int>(cinfo.output_height);
+  if (W < crop_w || H < crop_h) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return kErrTooSmall;
+  }
+  int x0 = crop_x >= 0 ? crop_x : (W - crop_w) / 2;
+  int y0 = crop_y >= 0 ? crop_y : (H - crop_h) / 2;
+  x0 = std::min(std::max(x0, 0), W - crop_w);
+  y0 = std::min(std::max(y0, 0), H - crop_h);
+
+  row.resize(static_cast<size_t>(W) * cinfo.output_components);
+  uint8_t* rp = row.data();
+  const size_t plane = static_cast<size_t>(crop_w) * crop_h;
+  // skip rows above the crop cheaply, stream the crop rows, abort early
+  if (y0 > 0) jpeg_skip_scanlines(&cinfo, static_cast<JDIMENSION>(y0));
+  for (int y = 0; y < crop_h; ++y) {
+    jpeg_read_scanlines(&cinfo, &rp, 1);
+    float* r_out = out + static_cast<size_t>(y) * crop_w;
+    float* g_out = r_out + plane;
+    float* b_out = g_out + plane;
+    const uint8_t* src = rp + static_cast<size_t>(x0) * 3;
+    if (mirror) {
+      for (int x = 0; x < crop_w; ++x) {
+        const uint8_t* px = src + static_cast<size_t>(crop_w - 1 - x) * 3;
+        r_out[x] = (px[0] - mean[0]) * std_inv[0];
+        g_out[x] = (px[1] - mean[1]) * std_inv[1];
+        b_out[x] = (px[2] - mean[2]) * std_inv[2];
+      }
+    } else {
+      for (int x = 0; x < crop_w; ++x) {
+        const uint8_t* px = src + static_cast<size_t>(x) * 3;
+        r_out[x] = (px[0] - mean[0]) * std_inv[0];
+        g_out[x] = (px[1] - mean[1]) * std_inv[1];
+        b_out[x] = (px[2] - mean[2]) * std_inv[2];
+      }
+    }
+  }
+  jpeg_abort_decompress(&cinfo);   // we stopped mid-image by design
+  jpeg_destroy_decompress(&cinfo);
+  return kOk;
+}
+
+}  // extern "C"
