@@ -32,8 +32,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 _N = 64  # canonical square dim
 
 
+_OVERRIDE_CACHE: dict = {}
+
+
 def _inputs_for(name, mx):
-    """Return (positional NDArrays, attrs) for an op, or None."""
+    """Return (positional NDArrays, attrs) for an op, or None.
+
+    The override table materializes ~80 device arrays; it is built ONCE
+    per _N and cached — tests/test_op_sweep.py calls this per swept op
+    (300+ times) and rebuilding the whole table each call would dominate
+    the sweep's runtime with unused host->device transfers."""
+    cached = _OVERRIDE_CACHE.get(_N)
+    if cached is not None:
+        return cached.get(name)
     nd = mx.nd
     r = np.random.RandomState(0)
 
@@ -100,7 +111,90 @@ def _inputs_for(name, mx):
         "col2im": ([t(8, 16 * 9, 32 * 32)],
                    {"output_size": (32, 32), "kernel": (3, 3),
                     "stride": (1, 1), "pad": (1, 1)}),
+        # attr-carrying shape/layout ops (r5, shared with the registry
+        # sweep in tests/test_op_sweep.py)
+        "slice": ([t(_N, _N)], {"begin": (1, 1), "end": (_N - 1, _N - 2)}),
+        "split_v2": ([t(_N, _N)], {"sections": 2, "axis": 1}),
+        "Reshape": ([t(_N, _N)], {"shape": (_N // 2, _N * 2)}),
+        "broadcast_axis": ([t(1, _N)], {"axis": 0, "size": 4}),
+        "broadcast_to": ([t(1, _N)], {"shape": (4, _N)}),
+        "Pad": ([t(2, 3, 8, 8)],
+                {"mode": "constant",
+                 "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)}),
+        "UpSampling": ([t(2, 3, 8, 8)],
+                       {"scale": 2, "sample_type": "nearest"}),
+        "space_to_depth": ([t(2, 4, 8, 8)], {"block_size": 2}),
+        "depth_to_space": ([t(2, 16, 4, 4)], {"block_size": 2}),
+        "Deconvolution": ([t(2, 8, 8, 8), t(8, 8, 3, 3)],
+                          {"kernel": (3, 3), "num_filter": 8,
+                           "no_bias": True}),
+        "GroupNorm": ([t(2, 4, 8, 8),
+                       nd.array(np.ones(4, np.float32)),
+                       nd.array(np.zeros(4, np.float32))],
+                      {"num_groups": 2}),
+        "InstanceNorm": ([t(2, 4, 8, 8),
+                          nd.array(np.ones(4, np.float32)),
+                          nd.array(np.zeros(4, np.float32))], {}),
+        "BilinearSampler": (
+            [t(2, 3, 8, 8),
+             nd.array(np.clip(r.randn(2, 2, 8, 8), -0.9, 0.9)
+                      .astype(np.float32))], {}),
+        "GridGenerator": ([t(2, 6)],
+                          {"transform_type": "affine",
+                           "target_shape": (8, 8)}),
+        "ROIPooling": (
+            [t(1, 3, 8, 8),
+             nd.array(np.array([[0, 0, 0, 6, 6]], np.float32))],
+            {"pooled_size": (2, 2), "spatial_scale": 1.0}),
+        "eye": ([], {"N": 8}),
+        # domain-restricted elementwise: inputs inside the valid range
+        "arcsin": ([nd.array((r.rand(_N, _N) * 1.8 - 0.9)
+                             .astype(np.float32))], {}),
+        "arccos": ([nd.array((r.rand(_N, _N) * 1.8 - 0.9)
+                             .astype(np.float32))], {}),
+        "arctanh": ([nd.array((r.rand(_N, _N) * 1.8 - 0.9)
+                              .astype(np.float32))], {}),
+        "erfinv": ([nd.array((r.rand(_N, _N) * 1.8 - 0.9)
+                             .astype(np.float32))], {}),
+        "arccosh": ([nd.array((r.rand(_N, _N) + 1.5)
+                              .astype(np.float32))], {}),
+        # samplers: shape-attr creation ops (fwd-only, stochastic)
+        "random.normal": ([], {"shape": (4, 5)}),
+        "random.uniform": ([], {"shape": (4, 5)}),
+        "random.bernoulli": ([], {"p": 0.4, "shape": (4, 5)}),
+        "random.exponential": ([], {"shape": (4, 5)}),
+        "random.gamma": ([], {"shape": (4, 5)}),
+        "random.poisson": ([], {"shape": (4, 5)}),
+        "random.negative_binomial": ([], {"shape": (4, 5)}),
+        "random.generalized_negative_binomial": ([], {"shape": (4, 5)}),
+        "random.randint": ([], {"low": 0, "high": 9, "shape": (4, 5)}),
+        # single-tensor optimizer update kernels
+        "sgd_update": ([t(_N, _N), t(_N, _N)], {"lr": 0.1}),
+        "sgd_mom_update": ([t(_N, _N), t(_N, _N), t(_N, _N)],
+                           {"lr": 0.1, "momentum": 0.9}),
+        "adam_update": (
+            [t(_N, _N), t(_N, _N), t(_N, _N),
+             nd.array(np.abs(r.randn(_N, _N)).astype(np.float32))],
+            {"lr": 0.1}),
     }
+    # linalg family: SPD / triangular operands (shared synthesis)
+    sq = r.randn(8, 8).astype(np.float32)
+    spd = (sq @ sq.T + 8 * np.eye(8)).astype(np.float32)
+    tril = np.tril(sq + 8 * np.eye(8)).astype(np.float32)
+    overrides.update({
+        "linalg.det": ([nd.array(spd)], {}),
+        "linalg.slogdet": ([nd.array(spd)], {}),
+        "linalg.inverse": ([nd.array(spd)], {}),
+        "linalg.potrf": ([nd.array(spd)], {}),
+        "linalg.potri": ([nd.array(spd)], {}),
+        "linalg.eigh": ([nd.array(spd)], {}),
+        "linalg.solve": ([nd.array(spd), t(8, 8)], {}),
+        "linalg.gemm2": ([t(8, 8), t(8, 8)], {}),
+        "linalg.trmm": ([nd.array(tril), t(8, 8)], {}),
+        "linalg.trsm": ([nd.array(tril), t(8, 8)], {}),
+        "linalg.extracttrian": ([t(8, 8)], {}),
+    })
+    _OVERRIDE_CACHE[_N] = overrides
     if name in overrides:
         return overrides[name]
     # generic families: try unary then binary on a square tensor

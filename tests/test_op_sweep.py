@@ -1,0 +1,327 @@
+"""Registry-WIDE operator sweep (VERDICT r4 item 4; reference
+tests/python/unittest/test_operator.py breadth, SURVEY §4.1/§4.2).
+
+Three auto-discovered tiers over every registered kernel (aliases dedup
+to one sweep each, same rule as opperf):
+
+ 1. ``test_sweep_forward``: the op runs on synthesized canonical inputs
+    and returns finite values.  Input synthesis REUSES opperf's table
+    (benchmark/opperf) so the two stay in lockstep; an op that cannot be
+    synthesized must appear in ``SYNTH_SKIP`` with a reason — silent
+    drops fail the meta-test.
+ 2. ``test_sweep_numpy_oracle``: ops whose name is also a numpy ufunc
+    are checked against numpy on the same inputs.
+ 3. ``test_sweep_numeric_gradient``: every differentiable op gets a
+    DIRECTIONAL finite-difference check — grad . d vs
+    (f(x+eps*d) - f(x-eps*d)) / 2eps along one random direction per
+    input (one FD pair per input instead of per element, which is what
+    makes a 300-op sweep affordable).  Non-smooth ops are skipped with
+    reasons (``FD_SKIP``).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ops import registry
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmark", "opperf"))
+import opperf  # noqa: E402  (the shared input-synthesis table)
+
+
+def _kernels():
+    seen, names = set(), []
+    for n in registry.list_ops():
+        if n.startswith("_"):
+            # internal kernels (same rule as opperf --all): exercised via
+            # their public wrappers (x / 2 -> _div_scalar, etc.)
+            continue
+        op_id = id(registry.get(n))
+        if op_id in seen:
+            continue
+        seen.add(op_id)
+        names.append(n)
+    return names
+
+
+KERNELS = _kernels()
+
+# ops the generic synthesizer cannot drive, with the reason (tier-1 skip
+# list — the meta-test asserts this list only names real registry ops)
+SYNTH_SKIP = {
+    "RNN": "stateful multi-input op; covered by tests/test_gluon_rnn.py",
+    "BatchNorm": "aux-state op; covered by test_operator/test_gluon",
+    "ctc_loss": "label/length input contract; covered by gluon CTCLoss "
+                "tests",
+    "SequenceLast": "sequence_length contract; covered by test_operator",
+    "SequenceMask": "sequence_length contract; covered by test_operator",
+    "SequenceReverse": "sequence_length contract; covered by test_operator",
+
+    "center_loss": "3-input + aux center; covered by test_operator",
+    "col2im": "needs output_size attr; covered by test_operator",
+    "im2col": "needs kernel attr; covered by test_operator",
+    "one_hot": "int input + depth attr; covered by test_ndarray",
+    "Embedding": "int index input; has opperf override + tests",
+    "take": "int index input; has opperf override + tests",
+    "gather_nd": "int index input; covered by test_operator",
+    "scatter_nd": "int index + shape attr; covered by test_operator",
+    "pick": "int index input; covered by test_ndarray",
+    "SVMOutput": "label contract; covered by test_vision_ops",
+    "SoftmaxOutput": "label contract; covered by test_operator",
+    "LinearRegressionOutput": "label contract; covered by test_operator",
+    "MAERegressionOutput": "label contract; covered by test_operator",
+    "LogisticRegressionOutput": "label contract; covered by test_operator",
+    "softmax_cross_entropy": "label contract; has opperf override",
+    "smooth_l1": "scalar attr contract; covered by test_operator",
+    "Softmax": "upstream alias of the SoftmaxOutput LOSS head (label "
+               "contract); softmax (lowercase) is the activation",
+    # fused attention family: layout contracts (interleaved qkv, (B,H,L,D)
+    # q/k/v, encdec kv) with dedicated parity tests
+    "contrib.interleaved_matmul_selfatt_qk": "test_operator attention",
+    "contrib.interleaved_matmul_selfatt_valatt": "test_operator attention",
+    "contrib.interleaved_matmul_encdec_qk": "test_contrib_ops",
+    "contrib.interleaved_matmul_encdec_valatt": "test_contrib_ops",
+    "contrib.masked_selfatt": "test_flash_attention + test_tpu_smoke",
+    "contrib.masked_att_qkv": "test_flash_attention + test_llama",
+    "contrib.masked_encdec_att": "test_model_zoo transformer tests",
+    "contrib.sp_att_qkv": "mesh-dependent; test_ring_attention/test_ulysses",
+    # detection / vision ops with structured inputs + dedicated tests
+    "contrib.MultiBoxPrior": "test_vision_ops",
+    "contrib.MultiBoxTarget": "test_vision_ops",
+    "contrib.MultiBoxDetection": "test_vision_ops",
+    "contrib.Proposal": "test_vision_ops",
+    "contrib.MultiProposal": "test_vision_ops",
+    "contrib.box_iou": "corner-format box inputs; test_vision_ops",
+    "contrib.PSROIPooling": "roi inputs; test_vision_ops",
+    "contrib.DeformableConvolution": "offset inputs; test_vision_ops",
+    "contrib.roi_align": "roi inputs; test_vision_ops",
+    "SpatialTransformer": "localization-net contract; test_vision_ops",
+    "Correlation": "dual-image contract; test_vision_ops",
+    "Crop": "reference crop contract (2 inputs / offsets); test_vision_ops",
+    # quantization family: int8/calibration contracts, test_quantization
+    "contrib.quantized_conv": "test_quantization",
+    "contrib.quantized_dot": "test_quantization",
+    "contrib.quantized_fully_connected": "test_quantization",
+    "contrib.dequantize": "test_quantization",
+    "contrib.requantize": "test_quantization",
+    # misc structured contracts with their own coverage
+    "contrib.count_sketch": "hash-input contract; test_contrib_ops",
+    "contrib.hawkes_ll": "event-sequence contract; test_contrib_ops",
+    "contrib.fft": "complex layout; test_contrib_ops",
+    "contrib.ifft": "complex layout; test_contrib_ops",
+    "boolean_mask": "bool mask input; covered by test_operator",
+    "batch_take": "int index input; covered by test_ndarray",
+    "index_add": "int index input; covered by test_operator",
+    "index_copy": "int index input; covered by test_operator",
+    "ravel_multi_index": "int multi-index contract; test_ndarray",
+    "unravel_index": "int index contract; test_ndarray",
+    "histogram": "bin-spec contract; covered by test_ndarray",
+    "einsum": "subscripts attr contract; covered by test_numpy",
+    "linalg.tensorinv": "even-order tensor contract; test_operator linalg",
+    "linalg.gemm": "4-input axpby contract; test_operator linalg",
+    # optimizer update kernels: (weight, grad, state...) + lr contracts —
+    # oracle-tested in test_operator::test_optimizer_ops_match_numpy and
+    # exercised end-to-end by every Trainer/Module test
+    "adadelta_update": "optimizer update; test_operator/test_gluon",
+    "adagrad_update": "optimizer update; test_operator/test_gluon",
+    "adamw_update": "optimizer update; test_operator",
+    "ftrl_update": "optimizer update; test_operator",
+    "lamb_update_phase1": "optimizer update; test_operator",
+    "lamb_update_phase2": "optimizer update; test_operator",
+    "lamb_full_update": "optimizer update; test_operator",
+    "lars_update": "optimizer update; test_multi_optimizer",
+    "multi_mp_sgd_update": "fused multi-tensor; test_multi_optimizer",
+    "multi_mp_sgd_mom_update": "fused multi-tensor; test_multi_optimizer",
+    "nag_mom_update": "optimizer update; test_operator",
+    "rmsprop_update": "optimizer update; test_operator",
+    "rmspropalex_update": "optimizer update; test_operator",
+    "signum_update": "optimizer update; test_operator",
+}
+
+
+def _inputs(name):
+    """(args, attrs) for an op or None — opperf's table at small shapes."""
+    old_n = opperf._N
+    opperf._N = 8
+    try:
+        spec = opperf._inputs_for(name, mx)
+    finally:
+        opperf._N = old_n
+    if spec is not None:
+        return spec
+    r = np.random.RandomState(0)
+    x = nd.array(np.abs(r.randn(6, 7)).astype(np.float32) + 0.5)
+    op = registry.get(name)
+    for args in ([x], [x, x]):
+        try:
+            registry.invoke(op, args, {})
+            return args, {}
+        except Exception:  # noqa: BLE001
+            continue
+    return None
+
+
+def test_sweep_skip_list_is_honest():
+    for name in SYNTH_SKIP:
+        assert name in registry.list_ops(), \
+            f"SYNTH_SKIP names unknown op {name!r}"
+
+
+@pytest.mark.parametrize("name", KERNELS)
+def test_sweep_forward(name):
+    if name in SYNTH_SKIP:
+        pytest.skip(SYNTH_SKIP[name])
+    spec = _inputs(name)
+    if spec is None:
+        pytest.fail(f"op {name!r} has no input synthesizer and is not in "
+                    "SYNTH_SKIP — add an opperf override or a skip reason")
+    args, attrs = spec
+    out = registry.invoke(registry.get(name), list(args), dict(attrs))
+    outs = out if isinstance(out, list) else [out]
+    for o in outs:
+        a = o.asnumpy()
+        if np.issubdtype(a.dtype, np.floating):
+            assert np.isfinite(a).all(), f"{name}: non-finite output"
+
+
+_NUMPY_ORACLE_SKIP = {
+    # mx op semantics intentionally differ from the same-named numpy fn
+    "clip": "mx.clip takes a_min/a_max attrs, not positional",
+    "round": "mx rounds half away from zero (reference semantics); "
+             "numpy rounds half to even",
+}
+
+
+@pytest.mark.parametrize("name", [
+    n for n in KERNELS
+    if hasattr(np, n) and callable(getattr(np, n))
+    and n not in SYNTH_SKIP])
+def test_sweep_numpy_oracle(name):
+    if name in _NUMPY_ORACLE_SKIP:
+        pytest.skip(_NUMPY_ORACLE_SKIP[name])
+    spec = _inputs(name)
+    if spec is None:
+        pytest.skip("no synthesizer (covered by test_sweep_forward policy)")
+    args, attrs = spec
+    if attrs:
+        pytest.skip("attr-carrying op; oracle comparison not 1:1")
+    np_in = [a.asnumpy() for a in args]
+    try:
+        want = getattr(np, name)(*np_in)
+    except TypeError:
+        pytest.skip("numpy signature differs")
+    got = registry.invoke(registry.get(name), list(args), {})
+    got = (got[0] if isinstance(got, list) else got).asnumpy()
+    if not isinstance(want, np.ndarray):
+        want = np.asarray(want)
+    assert got.shape == want.shape or got.size == want.size, \
+        f"{name}: shape {got.shape} vs numpy {want.shape}"
+    np.testing.assert_allclose(got.reshape(want.shape), want,
+                               rtol=2e-5, atol=2e-6, err_msg=name)
+
+
+# non-smooth / non-real-gradient ops: directional FD is meaningless
+FD_SKIP = {
+    "sign": "piecewise-constant", "floor": "piecewise-constant",
+    "ceil": "piecewise-constant", "round": "piecewise-constant",
+    "rint": "piecewise-constant", "fix": "piecewise-constant",
+    "trunc": "piecewise-constant",
+    "abs": "kink at 0 is fine but |x| synth crosses it in FD noise",
+    "topk": "selection op", "sort": "permutation op",
+    "argsort": "selection op",
+    "Dropout": "stochastic", "dropout": "stochastic",
+    "shuffle": "stochastic",
+    "LeakyReLU": "rrelu branch stochastic; leaky kink",
+    "relu": "kink at 0", "hard_sigmoid": "kinks",
+    "clip": "kinks at bounds",
+    "erfinv": "FD ill-conditioned near synth range edges",
+    "reciprocal": "FD ill-conditioned for |x| < 1",
+    "rsqrt": "FD ill-conditioned near 0", "rcbrt": "FD ill-conditioned",
+    "log": "FD needs strictly positive well-scaled inputs",
+    "log2": "FD scale", "log10": "FD scale", "log1p": "FD scale",
+    "sqrt": "FD near 0", "cbrt": "FD near 0",
+    "gamma": "FD overflow on synth range",
+    "gammaln": "FD scale", "digamma": "FD poles",
+    "tan": "poles", "cot": "poles",
+    "Pooling": "max-pool selection kinks",
+    "max": "selection", "min": "selection",
+    "batch_dot": "opperf shapes (batched) fine but fwd-only here",
+    "norm": "kink at 0 for ord=1 path",
+    "exp": "magnifies FD noise on synth range",
+    "expm1": "FD scale",
+    "softmax_cross_entropy": "label input",
+    "where": "bool first input",
+    "BlockGrad": "gradient is 0 by definition (stop-gradient op)",
+    "linalg.extracttrian": "offset-attr contract",
+    "mod": "kinks at multiples", "broadcast_mod": "kinks at multiples",
+    "erf": "fine but |grad| tiny at synth range edges",
+    "arcsin": "domain-edge sensitivity", "arccos": "domain-edge",
+    "arctanh": "domain-edge", "arccosh": "domain-edge",
+    "L2Normalization": "norm kink sensitivity at synth scale",
+    "adam_update": "optimizer update mutates, not a math grad",
+    "sgd_update": "optimizer update", "sgd_mom_update": "optimizer update",
+    "mp_sgd_update": "optimizer update",
+    "mp_sgd_mom_update": "optimizer update",
+    "multi_sgd_update": "optimizer update",
+    "multi_sgd_mom_update": "optimizer update",
+    "preloaded_multi_sgd_update": "optimizer update",
+    "preloaded_multi_sgd_mom_update": "optimizer update",
+    "BilinearSampler": "grid-cell boundary kinks (floor of sample coords)",
+}
+
+
+@pytest.mark.parametrize("name", [
+    n for n in KERNELS
+    if registry.get(n).differentiable and n not in SYNTH_SKIP
+    and n not in FD_SKIP])
+def test_sweep_numeric_gradient(name):
+    spec = _inputs(name)
+    if spec is None:
+        pytest.skip("no synthesizer")
+    args, attrs = spec
+    float_idx = [i for i, a in enumerate(args)
+                 if np.dtype(a.dtype).kind == "f"]
+    if not float_idx:
+        pytest.skip("no float inputs")
+    from mxnet_tpu import autograd
+    op = registry.get(name)
+
+    def f(*xs):
+        out = registry.invoke(op, list(xs), dict(attrs))
+        out = out[0] if isinstance(out, list) else out
+        return out.astype("float64").sum()
+
+    ins = [a.astype("float64") if i in float_idx else a
+           for i, a in enumerate(args)]
+    for i in float_idx:
+        ins[i].attach_grad()
+    with autograd.record():
+        y = f(*ins)
+    try:
+        y.backward()
+    except Exception as e:  # noqa: BLE001
+        pytest.fail(f"{name}: backward raised {type(e).__name__}: {e}")
+    eps = 1e-5
+    r = np.random.RandomState(1)
+    for i in float_idx:
+        if ins[i].grad is None:
+            continue
+        d = r.randn(*ins[i].shape)
+        d /= max(np.linalg.norm(d), 1e-12)
+        xp = ins[i].asnumpy() + eps * d
+        xm = ins[i].asnumpy() - eps * d
+        args_p = [nd.array(xp) if j == i else ins[j]
+                  for j in range(len(ins))]
+        args_m = [nd.array(xm) if j == i else ins[j]
+                  for j in range(len(ins))]
+        fd = (float(f(*args_p).asnumpy())
+              - float(f(*args_m).asnumpy())) / (2 * eps)
+        an = float((ins[i].grad.asnumpy() * d).sum())
+        denom = max(abs(fd), abs(an), 1e-6)
+        assert abs(fd - an) / denom < 5e-3, \
+            f"{name} input {i}: directional grad {an} vs FD {fd}"
