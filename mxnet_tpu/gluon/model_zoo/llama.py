@@ -143,9 +143,16 @@ class LlamaBlock(HybridBlock):
 class LlamaModel(HybridBlock):
     def __init__(self, vocab_size=128256, num_layers=2, units=64,
                  hidden=172, heads=4, kv_heads=2, attn_impl="fused",
-                 sp_axis="sp", **kwargs):
+                 sp_axis="sp", remat=None, **kwargs):
         super().__init__(**kwargs)
         self._units = units
+        # activation rematerialization per decoder block (the reference's
+        # MXNET_BACKWARD_DO_MIRROR memory/compute trade — SURVEY §5.7);
+        # None = read the env flag at construction
+        if remat is None:
+            from ... import config as _cfg
+            remat = bool(_cfg.get_int("MXNET_BACKWARD_DO_MIRROR", 0))
+        self._remat = bool(remat)
         with self.name_scope():
             self.embed = Embedding(vocab_size, units, prefix="tok_")
             self.blocks = []
@@ -161,9 +168,13 @@ class LlamaModel(HybridBlock):
 
     def hybrid_forward(self, F, tokens):
         # tokens: (B, L) int32 → logits (B, L, vocab)
+        from ... import autograd
         x = self.embed(tokens)
+        use_remat = self._remat and autograd.is_recording()
+        if use_remat:
+            from ..utils import remat_call
         for blk in self.blocks:
-            x = blk(x)
+            x = remat_call(blk, x) if use_remat else blk(x)
         return self.lm_head(self.norm(x))
 
 

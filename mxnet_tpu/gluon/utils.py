@@ -10,7 +10,7 @@ from .. import ndarray as nd
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
-           "download"]
+           "download", "remat_call"]
 
 
 def split_data(data, num_slice, batch_axis=0, even_split=True):
@@ -80,3 +80,69 @@ def download(url, path=None, overwrite=False, sha1_hash=None,
     raise MXNetError(
         f"cannot download {url}: network egress is unavailable in this "
         f"environment and {fname} is not cached locally")
+
+
+def remat_call(block, *inputs):
+    """Run ``block(*inputs)`` with activation REMATERIALIZATION: the
+    block's internal activations are not stored for backward — they are
+    recomputed from the block inputs during the gradient pass
+    (``jax.checkpoint``).  This is the TPU-native analog of the
+    reference's ``MXNET_BACKWARD_DO_MIRROR`` memory/compute trade
+    (docs/faq/env_var.md): backward does ~1 extra forward of compute and
+    activation memory drops from O(layers) to O(1) per wrapped segment —
+    what makes long-sequence configs fit one chip (SURVEY §5.7).
+
+    The whole block becomes ONE node on the autograd tape (its vjp is the
+    checkpointed function's vjp), so it composes with ``autograd.record``
+    / ``TrainStep`` like any fused op.  Blocks that MUTATE state in
+    forward (BatchNorm running stats) are rejected — the mutation would
+    silently vanish.
+    """
+    import jax
+    from .. import autograd
+    from ..ndarray.ndarray import swap_slot_values
+
+    params = [p for _, p in sorted(block.collect_params().items())]
+    in_ctx = next((a.ctx for a in inputs if isinstance(a, NDArray)), None)
+    param_nds = [p.data(in_ctx) for p in params]
+    arrays = [a._data for a in inputs] + [p._data for p in param_nds]
+    n_in = len(inputs)
+    train = autograd.is_training()
+    mutated = [False]
+
+    @jax.checkpoint
+    def f(*arrs):
+        in_arr, p_arr = arrs[:n_in], arrs[n_in:]
+        with swap_slot_values(zip(param_nds, p_arr)) as saved:
+            in_nds = [NDArray._from_data(a) for a in in_arr]
+            with autograd._scope(recording=False, training=train):
+                out = block(*in_nds)
+            if any(slot.value is not old and slot.value is not rep
+                   for (slot, old), rep in zip(saved, p_arr)):
+                mutated[0] = True
+            if isinstance(out, (list, tuple)):
+                raise MXNetError(
+                    "remat_call supports single-output blocks")
+            return out._data
+
+    if autograd.is_recording():
+        out_raw, vjp_fn = jax.vjp(f, *arrays)
+    else:
+        out_raw, vjp_fn = f(*arrays), None
+    if mutated[0]:
+        raise MXNetError(
+            "remat_call: block mutates state in forward (BatchNorm "
+            "running stats?) — rematerialization would re-run and then "
+            "DROP the mutation; wrap only pure blocks")
+    result = NDArray._from_data(out_raw, ctx=in_ctx)
+    if vjp_fn is not None:
+        # tape node with op=None (like autograd.Function): create_graph
+        # backward then replays the stored vjp closure instead of trying
+        # to re-dispatch a registry op that does not exist
+        all_ins = list(inputs) + param_nds
+        node = autograd._Node(
+            "_remat_block", vjp_fn, autograd._entries_for(all_ins),
+            [(result.shape, result.dtype)])
+        autograd._st().tape.append(node)
+        result._node = (node, 0)
+    return result

@@ -157,3 +157,71 @@ def test_sp_att_qkv_no_mesh_fallback(seeded):
                                            causal=True)
     np.testing.assert_allclose(out_sp.asnumpy(), out_ref.asnumpy(),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_llama_remat_parity():
+    """MXNET_BACKWARD_DO_MIRROR analog: remat per decoder block gives the
+    SAME forward and gradients as the stored-activation path (gluon.utils
+    .remat_call underneath — jax.checkpoint recompute in backward)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.model_zoo.llama import LlamaModel
+
+    r = np.random.RandomState(0)
+    toks = mx.nd.array(r.randint(0, 64, (2, 16)).astype(np.int32))
+
+    losses, grads = [], []
+    for remat in (False, True):
+        mx.random.seed(0)
+        m = LlamaModel(vocab_size=64, num_layers=2, units=32, hidden=96,
+                       heads=4, kv_heads=2, remat=remat,
+                       prefix=f"remat{int(remat)}_")
+        m.initialize(mx.initializer.Normal(0.05))
+        with autograd.record():
+            out = m(toks)
+            loss = (out.astype("float32") ** 2).mean()
+        loss.backward()
+        losses.append(float(loss.asnumpy()))
+        g = {k.split("_", 1)[1]: p.data().grad.asnumpy().copy()
+             for k, p in m.collect_params().items()
+             if p.data().grad is not None}
+        grads.append(g)
+    assert np.allclose(losses[0], losses[1], rtol=1e-5)
+    assert set(grads[0]) == set(grads[1]) and len(grads[0]) > 4
+    for k in grads[0]:
+        np.testing.assert_allclose(grads[0][k], grads[1][k],
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
+
+
+def test_llama_remat_trainstep():
+    """The remat path must trace through parallel.TrainStep (the bench
+    llama lane's exact mechanism) and match the no-remat loss."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.gluon.model_zoo.llama import LlamaModel
+
+    r = np.random.RandomState(0)
+    toks = r.randint(0, 64, (1, 8, 16)).astype(np.int32)
+    labs = r.randint(0, 64, (1, 8, 16)).astype(np.int32)
+
+    losses = []
+    for remat in (False, True):
+        mx.random.seed(0)
+        model = LlamaModel(vocab_size=64, num_layers=2, units=32, hidden=96,
+                           heads=4, kv_heads=2, remat=remat,
+                           prefix=f"ts_remat{int(remat)}_")
+        model.initialize(mx.initializer.Normal(0.05))
+
+        def loss_fn(out, labels):
+            return mx.nd.softmax_cross_entropy(
+                out.reshape((-1, out.shape[-1])).astype("float32"),
+                labels.reshape((-1,))) / labels.size
+
+        step = parallel.TrainStep(model, loss_fn,
+                                  mx.optimizer.Adam(learning_rate=1e-3),
+                                  mesh=parallel.make_mesh())
+        out = step.run(nd.array(toks), nd.array(labs))
+        losses.append(float(np.asarray(out.asnumpy())[-1]))
+    assert np.allclose(losses[0], losses[1], rtol=1e-5), losses
