@@ -283,3 +283,21 @@ def test_tpu_multi_sgd_consistency():
     a, b = list(outs.values())
     for x, y in zip(a, b):
         np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
+
+
+def test_tpu_flash_encdec_attention_consistency():
+    """Cross-attention (contrib.masked_encdec_att, r5 — the MT decoder's
+    fused op) flash ≡ dense ON THE CHIP, with Lq != Lk and source-padding
+    masking via the kernel's separate seg_q/seg_kv inputs."""
+    Lq, Lk, B, H, D = 256, 512, 2, 4, 64
+    r = np.random.RandomState(23)
+    q = (r.randn(Lq, B, H * D) * 0.3).astype(np.float32)
+    kv = (r.randn(Lk, B, 2 * H * D) * 0.3).astype(np.float32)
+    vl = np.array([400, 512], np.float32)
+    outs = {}
+    for ctx in _ctxs():
+        outs[str(ctx)] = mx.nd.contrib.masked_encdec_att(
+            mx.nd.array(q, ctx=ctx), mx.nd.array(kv, ctx=ctx),
+            mx.nd.array(vl, ctx=ctx), heads=H).asnumpy()
+    vals = list(outs.values())
+    np.testing.assert_allclose(vals[0], vals[1], rtol=5e-2, atol=5e-3)
