@@ -35,10 +35,13 @@ def _maybe_init_distributed():
     """Join the jax.distributed rendezvous when launched by tools/launch.py
     (must happen before any backend query like process_count)."""
     import jax
+    # honor JAX_PLATFORMS explicitly: PJRT plugins (the axon TPU tunnel)
+    # can ignore the env var, and a "cpu" request silently landing on the
+    # TPU would fake the multi-device measurement
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     coord = os.environ.get("MXNET_DIST_COORDINATOR")
     if coord:
-        if os.environ.get("JAX_PLATFORMS") == "cpu":
-            jax.config.update("jax_platforms", "cpu")
         try:
             jax.distributed.initialize(
                 coordinator_address=coord,
