@@ -209,10 +209,15 @@ def run_llama_once(batch, seq_len, dtype, scan_steps, dispatches):
     to end and must not OOM.
 
     r5: the lane model grew from the 4L/512u toy (MFU-bound by
-    un-amortized small matmuls: 0.18) to 8L/1024u with per-block
-    activation remat (gluon.utils.remat_call — the
-    MXNET_BACKWARD_DO_MIRROR analog), the largest config that holds
-    batch 8 x seq 2048 on one v5e.  Override via
+    un-amortized small matmuls: 0.18 MFU) through 8L/1024u (0.33) to
+    8L/2048u/5504h (390M params) at batch 4 — measured 0.595 MFU: wide
+    matmuls finally fill the MXU, and the O(L) flash path is what lets
+    seq-2048 train at this width on one chip.  Measured ladder (PROFILE
+    .md): 1024u b8 0.33 / b16 0.32; 2048u b4 0.60 / b8 0.53; 16L/1024u
+    0.32.  Remat (gluon.utils.remat_call, the MXNET_BACKWARD_DO_MIRROR
+    analog) is OFF by default — this config fits v5e HBM without it and
+    the recompute costs ~24% wall (0.25 vs 0.33 at 1024u); flip the 6th
+    arch field to 1 for configs that only fit WITH it.  Override via
     MXNET_BENCH_LLAMA_ARCH="layers,units,hidden,heads,kv_heads[,remat]".
     """
     import mxnet_tpu as mx
@@ -220,10 +225,10 @@ def run_llama_once(batch, seq_len, dtype, scan_steps, dispatches):
     from mxnet_tpu.gluon.model_zoo.llama import LlamaModel
 
     vocab = 8192   # bench vocab: keeps the LM head from dominating flops
-    arch = os.environ.get("MXNET_BENCH_LLAMA_ARCH", "8,1024,2752,16,8,1")
+    arch = os.environ.get("MXNET_BENCH_LLAMA_ARCH", "8,2048,5504,16,8,0")
     parts = [int(x) for x in arch.split(",")]
     layers, units, hidden, heads, kv_heads = parts[:5]
-    remat = bool(parts[5]) if len(parts) > 5 else True
+    remat = bool(parts[5]) if len(parts) > 5 else False
     mx.random.seed(0)
     np.random.seed(0)
     model = LlamaModel(vocab_size=vocab, num_layers=layers, units=units,
@@ -367,8 +372,8 @@ def main():
                              "MXNET_BENCH_SCAN_STEPS": "32"}),
             ("llama_seq2048", {"MXNET_BENCH_MODEL": "llama_longseq",
                                "MXNET_BENCH_SEQLEN": "2048",
-                               "MXNET_BENCH_BATCH": "8",
-                               "MXNET_BENCH_SCAN_STEPS": "16"}),
+                               "MXNET_BENCH_BATCH": "4",
+                               "MXNET_BENCH_SCAN_STEPS": "8"}),
             # the BASELINE config-2 vision number and the input-pipeline
             # rate belong in the round's permanent record (VERDICT r4
             # weak #5) — not as manual invocations

@@ -177,7 +177,7 @@ def profile_bert512(batch=32, seq=512, scan_steps=32):
     return results
 
 
-def profile_llama2048(batch=8, seq=2048, scan_steps=16):
+def profile_llama2048(batch=4, seq=2048, scan_steps=8):
     import jax
     import jax.numpy as jnp
     import ml_dtypes
@@ -186,7 +186,7 @@ def profile_llama2048(batch=8, seq=2048, scan_steps=16):
     # mirror bench.run_llama_once's arch (same env override); components
     # here measure the NO-remat cost — the remat lane's extra forward
     # shows up as part of the full-step residual
-    arch = os.environ.get("MXNET_BENCH_LLAMA_ARCH", "8,1024,2752,16,8,1")
+    arch = os.environ.get("MXNET_BENCH_LLAMA_ARCH", "8,2048,5504,16,8,0")
     layers, units, hidden, heads, kv_heads =         [int(x) for x in arch.split(",")][:5]
     vocab = 8192
     d_head = units // heads
@@ -265,7 +265,7 @@ def _full_step_ms(lane):
     if lane == "bert512":
         res = bench.run_once("bert_12_768_12", 32, 512, "bfloat16", 32, 1)
     else:
-        res = bench.run_llama_once(8, 2048, "bfloat16", 16, 1)
+        res = bench.run_llama_once(4, 2048, "bfloat16", 8, 1)
     return res["extra"]["step_ms"], res["extra"]["mfu"]
 
 
