@@ -113,10 +113,18 @@ def run(vocab=40, layers=2, units=64, hidden=128, heads=4, batch=32,
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None, choices=["cpu"],
+                    help="pin the jax platform IN-PROCESS (the axon PJRT "
+                         "plugin ignores the JAX_PLATFORMS env var, so an "
+                         "env-only 'cpu' request can silently land on a "
+                         "TPU tunnel)")
     ap.add_argument("--steps", type=int, default=300)
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--lr", type=float, default=3e-3)
     args = ap.parse_args(argv)
+    if args.platform or os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", args.platform or "cpu")
     rec = run(steps=args.steps, batch=args.batch, lr=args.lr)
     ok = rec["last_loss"] < rec["first_loss"]
     print(f"loss {rec['first_loss']:.3f} -> {rec['last_loss']:.3f}  "

@@ -112,9 +112,15 @@ def run(batch=16, steps=60, lr=5e-3, size=64, log=True, seed=0):
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--platform", default=None, choices=["cpu"],
+                   help="pin the jax platform IN-PROCESS (the axon PJRT "
+                        "plugin ignores the JAX_PLATFORMS env var)")
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--batch", type=int, default=16)
     a = p.parse_args()
+    if a.platform or os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", a.platform or "cpu")
     rec = run(batch=a.batch, steps=a.steps)
     return 0 if rec["last_loss"] < rec["first_loss"] else 1
 

@@ -226,24 +226,37 @@ def greedy_decode(model, src_tokens, bos_id, eos_id, max_len=64,
                   src_valid_length=None):
     """Greedy autoregressive decode: argmax next token until EOS/max_len.
 
-    Re-runs the decoder over the growing prefix each step (O(L^2) total —
-    the example/eval path; production serving would cache k/v).  Returns
-    (B, <=max_len) int32 including BOS, stopping early only when EVERY
-    sequence has emitted EOS.
+    The target rides a FIXED (B, max_len) buffer and every step runs the
+    same compiled shape — decoder causality makes the PAD tail beyond the
+    current position invisible to the positions that matter, so the
+    growing-prefix retrace (a fresh XLA compile per emitted token) never
+    happens.  O(L^2) total work (re-encodes each step — the example/eval
+    path; production serving would cache k/v).  Returns (B, <=max_len)
+    int32 including BOS, stopping early only when EVERY sequence has
+    emitted EOS.
     """
     import numpy as np
     from ... import ndarray as mxnd
     B = src_tokens.shape[0]
-    tgt = np.full((B, 1), bos_id, np.int32)
+    # the fixed buffer embeds positions 0..max_len-1 every step, so it
+    # must fit the model's position table (the growing-prefix variant
+    # only failed if decoding actually REACHED the limit)
+    cap = getattr(model, "_pos", None)
+    if cap is not None:
+        max_len = min(max_len, cap.shape[0])
+    buf = np.full((B, max_len), eos_id, np.int32)   # pad tail = EOS id
+    buf[:, 0] = bos_id
     done = np.zeros((B,), bool)
-    for _ in range(max_len - 1):
-        logits = model(src_tokens, mxnd.array(tgt),
+    n = 1
+    for t in range(max_len - 1):
+        logits = model(src_tokens, mxnd.array(buf),
                        src_valid_length) if src_valid_length is not None \
-            else model(src_tokens, mxnd.array(tgt))
-        nxt = np.asarray(logits.asnumpy()[:, -1].argmax(-1), np.int32)
+            else model(src_tokens, mxnd.array(buf))
+        nxt = np.asarray(logits.asnumpy()[:, t].argmax(-1), np.int32)
         nxt = np.where(done, eos_id, nxt)
-        tgt = np.concatenate([tgt, nxt[:, None]], axis=1)
+        buf[:, t + 1] = nxt
         done |= nxt == eos_id
+        n = t + 2
         if done.all():
             break
-    return tgt
+    return buf[:, :n]

@@ -163,3 +163,60 @@ def test_image_record_iter_process_decoder(tmp_path):
     for (rd, rl), (gd, gl) in zip(ref, got):
         np.testing.assert_allclose(gd, rd)
         np.testing.assert_allclose(gl, rl)
+
+
+# -- r5: native fused JPEG decode (src/jpeg_decode.cc) ---------------------
+
+def _jpeg_bytes(img_rgb, quality=95):
+    import cv2
+    ok, buf = cv2.imencode(".jpg", cv2.cvtColor(img_rgb, cv2.COLOR_RGB2BGR),
+                           [cv2.IMWRITE_JPEG_QUALITY, quality])
+    assert ok
+    return buf.tobytes()
+
+
+def test_jpeg_decode_parity_and_mirror():
+    """Fused decode+crop+normalize matches the cv2 reference path within
+    the documented IFAST tolerance (<= ~4/255), incl. mirror and offsets."""
+    import cv2
+    from mxnet_tpu import native
+    if not native.jpeg_decode_available():
+        pytest.skip("no native jpeg decoder on this host")
+    yy, xx = np.mgrid[0:96, 0:96]
+    img = np.stack([xx * 2, yy * 2, xx + yy], -1).astype(np.uint8)
+    b = _jpeg_bytes(img)
+    assert native.jpeg_dims(b) == (96, 96)
+    full = cv2.cvtColor(cv2.imdecode(np.frombuffer(b, np.uint8),
+                                     cv2.IMREAD_COLOR), cv2.COLOR_BGR2RGB)
+    mean, std = (10.0, 20.0, 30.0), (50.0, 60.0, 70.0)
+    for xy, mirror in (((0, 0), False), ((5, 9), False), ((5, 9), True)):
+        out = native.jpeg_decode_crop_norm(b, (64, 64), crop_xy=xy,
+                                           mirror=mirror, mean=mean,
+                                           std=std)
+        ref = full[xy[1]:xy[1] + 64, xy[0]:xy[0] + 64].astype(np.float32)
+        if mirror:
+            ref = ref[:, ::-1]
+        ref = (ref - np.array(mean, np.float32)) / np.array(std, np.float32)
+        diff = np.abs(ref.transpose(2, 0, 1) - out)
+        # IFAST DCT + plain upsampling: <= ~4 raw units / min(std)
+        assert diff.max() <= 5.0 / 50.0, (xy, mirror, diff.max())
+
+
+def test_jpeg_decode_scaled_and_fallbacks():
+    from mxnet_tpu import native
+    if not native.jpeg_decode_available():
+        pytest.skip("no native jpeg decoder on this host")
+    img = np.random.RandomState(0).randint(0, 255, (512, 512, 3), np.uint8)
+    b = _jpeg_bytes(img)
+    # min_side <= 0: FULL decode (crop semantics demand original pixels)
+    out = native.jpeg_decode_crop_norm(b, (96, 96), crop_xy=(400, 400))
+    assert out is not None and out.shape == (3, 96, 96)
+    # min_side > 0: scaled IDCT may shrink, still covering crop+min_side
+    out = native.jpeg_decode_crop_norm(b, (224, 224), min_side=256)
+    assert out is not None and out.shape == (3, 224, 224)
+    # undersized image -> None (caller falls back to the resize path)
+    small = _jpeg_bytes(np.zeros((32, 32, 3), np.uint8))
+    assert native.jpeg_decode_crop_norm(small, (64, 64)) is None
+    # non-JPEG payload -> None
+    assert native.jpeg_decode_crop_norm(b"not a jpeg", (8, 8)) is None
+    assert native.jpeg_dims(b"nope") is None
