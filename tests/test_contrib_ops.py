@@ -324,3 +324,42 @@ def test_fft_gradient_roundtrip():
     loss.backward()
     # d/dx sum(ifft(fft(x))/n) == 1 elementwise (linear roundtrip)
     np.testing.assert_allclose(x.grad.asnumpy(), 1.0, rtol=1e-4, atol=1e-4)
+
+
+def test_masked_encdec_att_matches_unfused_chain():
+    """The fused cross-attention op (r5) ≡ the reference-shaped unfused
+    chain interleaved_matmul_encdec_qk → (mask) → softmax →
+    interleaved_matmul_encdec_valatt — the layout contract both share."""
+    r = np.random.RandomState(7)
+    Lq, Lk, B, H, D = 6, 9, 2, 2, 4
+    q = nd.array(r.randn(Lq, B, H * D).astype(np.float32))
+    kv = nd.array(r.randn(Lk, B, 2 * H * D).astype(np.float32))
+    vl = nd.array(np.array([9, 5], np.float32))
+
+    fused = nd.contrib.masked_encdec_att(q, kv, vl, heads=H).asnumpy()
+
+    att = nd.contrib.interleaved_matmul_encdec_qk(q, kv, heads=H)
+    # source-padding mask between qk and softmax (GluonNLP decoder contract)
+    a = att.asnumpy().reshape(B, H, Lq, Lk)
+    mask = np.arange(Lk)[None, :] < vl.asnumpy()[:, None]
+    a = np.where(mask[:, None, None, :], a, -1e9)
+    p = np.exp(a - a.max(-1, keepdims=True))
+    p = (p / p.sum(-1, keepdims=True)).reshape(B * H, Lq, Lk)
+    chain = nd.contrib.interleaved_matmul_encdec_valatt(
+        kv, nd.array(p.astype(np.float32)), heads=H).asnumpy()
+    np.testing.assert_allclose(fused, chain, rtol=1e-4, atol=1e-5)
+
+
+def test_masked_encdec_att_grads_flow():
+    from mxnet_tpu import autograd
+    r = np.random.RandomState(8)
+    q = nd.array(r.randn(4, 2, 8).astype(np.float32))
+    kv = nd.array(r.randn(5, 2, 16).astype(np.float32))
+    q.attach_grad()
+    kv.attach_grad()
+    with autograd.record():
+        out = nd.contrib.masked_encdec_att(q, kv, None, heads=2)
+        loss = (out * out).sum()
+    loss.backward()
+    assert np.isfinite(q.grad.asnumpy()).all()
+    assert np.abs(kv.grad.asnumpy()).sum() > 0

@@ -176,3 +176,33 @@ def test_yolo3_darknet53_constructs():
     n_convs = sum(1 for k in net.collect_params().keys()
                   if "conv" in k and k.endswith("weight"))
     assert n_convs >= 52 + 3        # darknet53 + heads
+
+
+def test_transformer_hybridize_parity(seeded):
+    from mxnet_tpu.gluon.model_zoo import transformer
+    m = transformer.transformer_model("transformer_test", vocab_size=40,
+                                      max_length=16, dropout=0.0)
+    m.initialize(mx.initializer.Normal(0.05))
+    r = np.random.RandomState(3)
+    src = mx.nd.array(r.randint(0, 40, (2, 10)).astype(np.int32))
+    tgt = mx.nd.array(r.randint(0, 40, (2, 8)).astype(np.int32))
+    imp = m(src, tgt).asnumpy()
+    m.hybridize()
+    hyb = m(src, tgt).asnumpy()
+    np.testing.assert_allclose(imp, hyb, rtol=1e-4, atol=1e-5)
+
+
+def test_yolo3_hybridize_parity(seeded):
+    from mxnet_tpu.gluon.model_zoo import yolo
+    net = yolo.YOLOV3(
+        backbone=yolo.Darknet(layers=(1, 1, 1, 1, 1),
+                              channels=(4, 8, 16, 32, 64, 128)),
+        classes=2, channels=(32, 16, 8))
+    net.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(np.random.RandomState(5)
+                    .randn(2, 3, 64, 64).astype(np.float32))
+    imp = [o.asnumpy() for o in net(x)]
+    net.hybridize()
+    hyb = [o.asnumpy() for o in net(x)]
+    for a, b in zip(imp, hyb):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
