@@ -54,8 +54,21 @@ def make_batch(rng, batch, vocab, min_len=4, max_len=12):
     return src, lens.astype(np.int32), tgt_in, tgt_out
 
 
+def _token_acc(out, vl, tgt_out):
+    """Exact-token accuracy of decoded rows vs the reversal ground truth."""
+    correct = total = 0
+    for i, n in enumerate(vl):
+        want = tgt_out[i, :n]
+        got = out[i, 1:n + 1] if out.shape[1] > n else out[i, 1:]
+        m = min(len(want), len(got))
+        correct += int((want[:m] == got[:m]).sum())
+        total += int(n)
+    return correct / max(total, 1)
+
+
 def run(vocab=40, layers=2, units=64, hidden=128, heads=4, batch=32,
-        steps=300, lr=3e-3, warmup=30, seed=0, log=True, decode_samples=8):
+        steps=300, lr=3e-3, warmup=30, seed=0, log=True, decode_samples=8,
+        beam_size=0):
     import mxnet_tpu as mx
     from mxnet_tpu import autograd, gluon
     from mxnet_tpu.gluon.model_zoo import transformer
@@ -96,19 +109,20 @@ def run(vocab=40, layers=2, units=64, hidden=128, heads=4, batch=32,
     out = transformer.greedy_decode(
         model, mx.nd.array(src), BOS, EOS,
         max_len=src.shape[1] + 2, src_valid_length=mx.nd.array(vl))
-    correct = total = 0
-    for i, n in enumerate(vl):
-        want = tgt_out[i, :n]
-        got = out[i, 1:n + 1] if out.shape[1] > n else out[i, 1:]
-        m = min(len(want), len(got))
-        correct += int((want[:m] == got[:m]).sum())
-        total += int(n)
-    acc = correct / max(total, 1)
+    acc = _token_acc(out, vl, tgt_out)
+    rec = {"first_loss": first_loss, "last_loss": last_loss,
+           "decode_acc": acc}
+    if beam_size >= 1:
+        bout, _ = transformer.beam_search_decode(
+            model, mx.nd.array(src), BOS, EOS, beam_size=beam_size,
+            max_len=src.shape[1] + 2, src_valid_length=mx.nd.array(vl))
+        rec["beam_decode_acc"] = _token_acc(bout, vl, tgt_out)
     if log:
-        print(f"greedy decode token acc: {acc:.3f} "
-              f"({time.time() - t0:.1f}s total)")
-    return {"first_loss": first_loss, "last_loss": last_loss,
-            "decode_acc": acc}
+        print(f"greedy decode token acc: {acc:.3f}"
+              + (f"  beam-{beam_size} acc: {rec['beam_decode_acc']:.3f}"
+                 if beam_size >= 1 else "")
+              + f" ({time.time() - t0:.1f}s total)")
+    return rec
 
 
 def main(argv=None):
@@ -121,11 +135,14 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=300)
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--beam", type=int, default=0,
+                    help="also report beam-search decode accuracy")
     args = ap.parse_args(argv)
     if args.platform or os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
         jax.config.update("jax_platforms", args.platform or "cpu")
-    rec = run(steps=args.steps, batch=args.batch, lr=args.lr)
+    rec = run(steps=args.steps, batch=args.batch, lr=args.lr,
+              beam_size=args.beam)
     ok = rec["last_loss"] < rec["first_loss"]
     print(f"loss {rec['first_loss']:.3f} -> {rec['last_loss']:.3f}  "
           f"decode_acc {rec['decode_acc']:.3f}  {'OK' if ok else 'FAIL'}")

@@ -25,7 +25,7 @@ from ..nn import Dense, Dropout, LayerNorm
 
 __all__ = ["TransformerEncoderCell", "TransformerDecoderCell",
            "TransformerEncoder", "TransformerDecoder", "TransformerModel",
-           "transformer_model", "greedy_decode"]
+           "transformer_model", "greedy_decode", "beam_search_decode"]
 
 
 def _positional_encoding(max_len, units):
@@ -260,3 +260,102 @@ def greedy_decode(model, src_tokens, bos_id, eos_id, max_len=64,
         if done.all():
             break
     return buf[:, :n]
+
+
+def beam_search_decode(model, src_tokens, bos_id, eos_id, beam_size=4,
+                       max_len=64, alpha=0.6, src_valid_length=None):
+    """Beam-search decode (the GluonNLP BeamSearchSampler role for MT).
+
+    Length-normalized scores use the GNMT penalty
+    ``((5 + len) / 6) ** alpha``; hypotheses that emit EOS move to a
+    COMPLETED pool at their normalized score (so a short finished
+    hypothesis is never evicted by longer raw-score competitors — the
+    BeamSearchScorer contract), and the search stops early once every
+    live beam is worse than the pool even with the best possible
+    remaining score.  Same fixed-shape discipline as ``greedy_decode``:
+    one (B*K, max_len) buffer, one compiled shape per step (causality
+    hides the pad tail).  Host-side numpy picks the beams — the
+    example/eval path; production serving would jit the loop with k/v
+    caches.  Returns (best (B, <=max_len) int32 incl. BOS, scores (B,)
+    length-normalized log-probs).
+    """
+    import numpy as np
+    from ... import ndarray as mxnd
+    B = src_tokens.shape[0]
+    K = beam_size
+    cap = getattr(model, "_pos", None)
+    if cap is not None:
+        max_len = min(max_len, cap.shape[0])
+    src_np = src_tokens.asnumpy() if hasattr(src_tokens, "asnumpy") \
+        else np.asarray(src_tokens)
+    # each batch row replicated K times: (B*K, Ls), beams vary the target
+    src_rep = mxnd.array(np.repeat(src_np, K, axis=0))
+    vl_rep = None
+    if src_valid_length is not None:
+        vl_np = src_valid_length.asnumpy() \
+            if hasattr(src_valid_length, "asnumpy") \
+            else np.asarray(src_valid_length)
+        vl_rep = mxnd.array(np.repeat(vl_np, K, axis=0))
+
+    def penalty(length):
+        return ((5.0 + length) / 6.0) ** alpha
+
+    buf = np.full((B, K, max_len), eos_id, np.int32)
+    buf[:, :, 0] = bos_id
+    scores = np.full((B, K), -np.inf, np.float64)
+    scores[:, 0] = 0.0            # beams start identical: keep one live
+    # completed pool: per batch row, the best (normalized_score, tokens)
+    best_done = [(-np.inf, None)] * B
+    n = 1
+    for t in range(max_len - 1):
+        flat = mxnd.array(buf.reshape(B * K, max_len))
+        logits = model(src_rep, flat, vl_rep) if vl_rep is not None \
+            else model(src_rep, flat)
+        # slice + log_softmax ON DEVICE (the registered op — one
+        # log-softmax implementation in the codebase), then pull only the
+        # (B*K, V) step slice over the tunnel
+        logp = mxnd.log_softmax(logits[:, t], axis=-1).asnumpy() \
+            .astype(np.float64)
+        V = logp.shape[-1]
+        logp = logp.reshape(B, K, V)
+        # EOS continuations COMPLETE a hypothesis: score it normalized
+        # into the pool, then exclude EOS from the live expansion
+        for b in range(B):
+            for k in range(K):
+                if not np.isfinite(scores[b, k]):
+                    continue
+                fin = (scores[b, k] + logp[b, k, eos_id]) / penalty(t + 1)
+                if fin > best_done[b][0]:
+                    seq = buf[b, k, :t + 2].copy()
+                    seq[t + 1] = eos_id
+                    best_done[b] = (fin, seq)
+        logp[:, :, eos_id] = -np.inf
+        cand = scores[:, :, None] + logp            # (B, K, V)
+        flat_cand = cand.reshape(B, K * V)
+        part = np.argpartition(-flat_cand, K - 1, axis=1)[:, :K]
+        part_scores = np.take_along_axis(flat_cand, part, 1)
+        order = np.argsort(-part_scores, axis=1)
+        top = np.take_along_axis(part, order, 1)     # (B, K) best-first
+        new_scores = np.take_along_axis(flat_cand, top, 1)
+        beam_idx, tok_idx = top // V, top % V
+        buf = np.take_along_axis(
+            buf, beam_idx[:, :, None].astype(np.int64), axis=1)
+        buf[:, :, t + 1] = tok_idx.astype(np.int32)
+        scores = new_scores
+        n = t + 2
+        # early stop: even a perfect (0 log-prob) continuation cannot
+        # beat the completed pool for any row
+        bound = scores[:, 0] / penalty(max_len - 1)
+        if all(best_done[b][0] >= bound[b] for b in range(B)):
+            break
+    out = np.full((B, n), eos_id, np.int32)
+    final = np.empty((B,), np.float64)
+    for b in range(B):
+        sc, seq = best_done[b]
+        if seq is None:
+            # no hypothesis ever finished: fall back to the best live beam
+            seq = buf[b, 0, :n]
+            sc = scores[b, 0] / penalty(n - 1)
+        out[b, :len(seq)] = seq[:n]
+        final[b] = sc
+    return out, final

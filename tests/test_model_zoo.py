@@ -206,3 +206,27 @@ def test_yolo3_hybridize_parity(seeded):
     hyb = [o.asnumpy() for o in net(x)]
     for a, b in zip(imp, hyb):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_beam_search_decode(seeded):
+    from mxnet_tpu.gluon.model_zoo import transformer
+    m = transformer.transformer_model("transformer_test", vocab_size=30,
+                                      max_length=16, dropout=0.0)
+    m.initialize(mx.initializer.Normal(0.05))
+    r = np.random.RandomState(0)
+    src = mx.nd.array(r.randint(3, 30, (3, 8)).astype(np.int32))
+    vl = mx.nd.array(np.array([8, 6, 4], np.int32))
+    for k in (1, 4):
+        out, scores = transformer.beam_search_decode(
+            m, src, 1, 2, beam_size=k, max_len=12, src_valid_length=vl)
+        assert out.shape[0] == 3 and out.shape[1] <= 12
+        assert (out[:, 0] == 1).all()                 # BOS prefix
+        assert ((out >= 0) & (out < 30)).all()
+        # every row terminates with EOS (completed pool or fallback pad)
+        assert (out == 2).any(axis=1).all()
+        assert np.isfinite(scores).all()
+        # deterministic: same inputs -> same beams
+        out2, scores2 = transformer.beam_search_decode(
+            m, src, 1, 2, beam_size=k, max_len=12, src_valid_length=vl)
+        np.testing.assert_array_equal(out, out2)
+        np.testing.assert_allclose(scores, scores2)
