@@ -168,6 +168,15 @@ def _inputs_for(name, mx):
         "random.negative_binomial": ([], {"shape": (4, 5)}),
         "random.generalized_negative_binomial": ([], {"shape": (4, 5)}),
         "random.randint": ([], {"low": 0, "high": 9, "shape": (4, 5)}),
+        # r5 op additions
+        "contrib.AdaptiveAvgPooling2D": ([t(2, 4, 8, 8)],
+                                         {"output_size": (2, 2)}),
+        "contrib.BilinearResize2D": ([t(2, 4, 8, 8)],
+                                     {"height": 16, "width": 16}),
+        "linalg.gelqf": ([t(8, 8)], {}),
+        "linalg.maketrian": ([t(36)], {}),
+        "amp_multicast": ([t(_N, _N), t(_N, _N)], {"num_outputs": 2}),
+        "contrib.getnnz": ([t(_N, _N)], {}),
         # single-tensor optimizer update kernels
         "sgd_update": ([t(_N, _N), t(_N, _N)], {"lr": 0.1}),
         "sgd_mom_update": ([t(_N, _N), t(_N, _N), t(_N, _N)],

@@ -14,6 +14,7 @@ from . import register as _register
 _GENERATED = _register.populate(_sys.modules[__name__])
 
 from . import sparse  # noqa: F401,E402
+from .sparse import cast_storage  # noqa: F401,E402  (reference nd.cast_storage)
 
 
 def imresize(*args, **kwargs):

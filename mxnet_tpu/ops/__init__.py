@@ -43,6 +43,7 @@ _ALIASES = {
     "ElementWiseSum": "add_n",
     "l2_normalization": "L2Normalization",
     "logical_xor": "broadcast_logical_xor",
+    "contrib.boolean_mask": "boolean_mask",   # 1.x contrib namespace alias
 }
 for _alias, _target in _ALIASES.items():
     registry.alias(_alias, _target)

@@ -285,3 +285,32 @@ def _clip(x, a_min=None, a_max=None):
 def _digamma(x):
     import jax
     return jax.scipy.special.digamma(x)
+
+
+@register("log_sigmoid")
+def _log_sigmoid(x):
+    """reference 1.8 log_sigmoid: log(1/(1+exp(-x))) = -softplus(-x)."""
+    import jax
+    return -jax.nn.softplus(-x)
+
+
+@register("mish")
+def _mish(x):
+    """reference 1.8 mish: x * tanh(softplus(x))."""
+    import jax
+    return x * _jnp().tanh(jax.nn.softplus(x))
+
+
+@register("amp_multicast", num_outputs=-1)
+def _amp_multicast(*data, num_outputs=0, cast_narrow=False):  # noqa: ARG001
+    """reference amp_multicast: cast every input to a COMMON dtype — the
+    widest float present (or the narrowest with cast_narrow), the AMP
+    pass's multi-input harmonizer."""
+    jnp = _jnp()
+    floats = [d.dtype for d in data
+              if jnp.issubdtype(d.dtype, jnp.floating)]
+    if not floats:
+        return list(data)
+    order = sorted(floats, key=lambda t: jnp.finfo(t).bits)
+    common = order[0] if cast_narrow else order[-1]
+    return [d.astype(common) for d in data]

@@ -169,3 +169,39 @@ def _pinv(A, rcond=1e-15):
 @register("einsum")
 def _einsum(*operands, subscripts=""):
     return _jnp().einsum(subscripts, *operands)
+
+
+@register("linalg.gelqf", num_outputs=2)
+def _gelqf(A):
+    """LQ factorization (reference la_op.cc gelqf): A = L @ Q with L lower
+    triangular, Q row-orthonormal — computed as the transposed QR of A^T
+    (XLA owns the QR kernel)."""
+    jnp = _jnp()
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg.maketrian")
+def _maketrian(d, offset=0, lower=True):
+    """Unpack a packed triangle vector into an (n, n) matrix — the inverse
+    of linalg.extracttrian (reference la_op.cc)."""
+    jnp = _jnp()
+    import numpy as np
+    m = d.shape[-1]
+    # solve n from the packed length (offset shifts the count; a static
+    # attr, so the trace-time search costs nothing)
+    def _count(n):
+        return len(np.tril_indices(n, offset)[0]) if lower \
+            else len(np.triu_indices(n, offset)[0])
+    n = 1
+    while _count(n) < m:
+        n += 1
+    if _count(n) != m:
+        raise ValueError(f"maketrian: packed length {m} does not match "
+                         f"any square size at offset {offset}")
+    if lower:
+        rows, cols = np.tril_indices(n, offset)
+    else:
+        rows, cols = np.triu_indices(n, offset)
+    out = jnp.zeros(d.shape[:-1] + (n, n), d.dtype)
+    return out.at[..., rows, cols].set(d)

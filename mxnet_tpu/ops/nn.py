@@ -861,3 +861,15 @@ _wire_inputs("LinearRegressionOutput", ("data", "label"))
 _wire_inputs("MAERegressionOutput", ("data", "label"))
 _wire_inputs("LogisticRegressionOutput", ("data", "label"))
 _wire_inputs("center_loss", ("data", "label", "center"), aux=("center",))
+
+
+@register("BatchNormWithReLU", num_outputs=3, visible_outputs=1,
+          mutate_inputs=((1, 3), (2, 4)), wrap_train="_training")
+def _batch_norm_with_relu(data, gamma, beta, moving_mean, moving_var,
+                          **kwargs):
+    """Fused BN+ReLU (reference batch_norm_relu.cc — the oneDNN/cuDNN
+    fusion; XLA fuses the relu into the normalize anyway, so this is the
+    API surface, same aux-state contract as BatchNorm)."""
+    out, mm, mv = _batch_norm(data, gamma, beta, moving_mean, moving_var,
+                              **kwargs)
+    return _jnp().maximum(out, 0), mm, mv

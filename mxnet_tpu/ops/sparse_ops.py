@@ -112,3 +112,12 @@ def _sparse_retain_values(data, indices, row_ids):
     jnp = _jnp()
     mask = jnp.isin(indices, row_ids.astype(indices.dtype))
     return data * mask[:, None].astype(data.dtype)
+
+
+@register("contrib.getnnz", differentiable=False)
+def _getnnz(data, axis=None):
+    """Count stored non-zeros (reference contrib getnnz for CSR; here the
+    dense analog counts actual non-zeros — the storage classes report
+    their stored length directly)."""
+    jnp = _jnp()
+    return jnp.sum((data != 0).astype(jnp.int64), axis=axis)

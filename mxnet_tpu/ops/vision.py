@@ -732,3 +732,58 @@ def _multi_proposal(cls_prob, bbox_pred, im_info, **kwargs):
     """Batch variant (reference multi_proposal.cc) — the host-side
     implementation above already loops the batch."""
     return _proposal(cls_prob, bbox_pred, im_info, **kwargs)
+
+
+@register("contrib.AdaptiveAvgPooling2D")
+def _adaptive_avg_pooling2d(data, output_size=(1, 1)):
+    """reference src/operator/contrib/adaptive_avg_pooling.cc (GluonCV's
+    global-context heads): average-pool NCHW to an arbitrary output grid
+    using the same floor/ceil bin edges as the reference kernel."""
+    jnp = _jnp()
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    if len(output_size) == 1:
+        output_size = (output_size[0],) * 2
+    oh, ow = int(output_size[0]), int(output_size[1])
+    n, c, h, w = data.shape
+    # bins with floor/ceil edges (adaptive pooling contract); static
+    # python loops — oh/ow are attrs, so the graph stays shape-static
+    rows = []
+    for i in range(oh):
+        y0, y1 = (i * h) // oh, -(-((i + 1) * h) // oh)
+        cols = []
+        for j in range(ow):
+            x0, x1 = (j * w) // ow, -(-((j + 1) * w) // ow)
+            cols.append(jnp.mean(data[:, :, y0:y1, x0:x1], axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)                  # (N, C, oh, ow)
+
+
+@register("contrib.BilinearResize2D")
+def _bilinear_resize2d(data, height=0, width=0, scale_height=None,
+                       scale_width=None, align_corners=True):
+    """reference src/operator/contrib/bilinear_resize.cc (segmentation
+    decoders): bilinear NCHW resize.  align_corners sampling is applied
+    PER AXIS (a size-1 output axis degenerates to scale 0 without
+    disturbing the other axis, like the reference kernel); the 4-tap
+    blend reuses the module's shared ``_bilinear_gather`` core."""
+    jnp = _jnp()
+    n, c, h, w = data.shape
+    oh = int(height) if height else int(round(h * (scale_height or 1.0)))
+    ow = int(width) if width else int(round(w * (scale_width or 1.0)))
+
+    def axis_coords(size_in, size_out):
+        if align_corners and size_out > 1:
+            return jnp.linspace(0.0, size_in - 1.0, size_out)
+        if align_corners:          # degenerate axis: reference scale 0
+            return jnp.zeros((size_out,))
+        c = (jnp.arange(size_out) + 0.5) * (size_in / size_out) - 0.5
+        return jnp.clip(c, 0, size_in - 1)
+
+    ys = axis_coords(h, oh)
+    xs = axis_coords(w, ow)
+    gy = jnp.broadcast_to(ys[:, None], (oh, ow))[None]     # (1, oh, ow)
+    gx = jnp.broadcast_to(xs[None, :], (oh, ow))[None]
+    gy = jnp.broadcast_to(gy, (n, oh, ow))
+    gx = jnp.broadcast_to(gx, (n, oh, ow))
+    return _bilinear_gather(data, gx, gy)

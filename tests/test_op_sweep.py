@@ -83,6 +83,8 @@ SYNTH_SKIP = {
     "LogisticRegressionOutput": "label contract; covered by test_operator",
     "softmax_cross_entropy": "label contract; has opperf override",
     "smooth_l1": "scalar attr contract; covered by test_operator",
+    "BatchNormWithReLU": "aux-state op (same contract as BatchNorm); "
+                         "covered by test_operator r5 additions",
     "Softmax": "upstream alias of the SoftmaxOutput LOSS head (label "
                "contract); softmax (lowercase) is the activation",
     # fused attention family: layout contracts (interleaved qkv, (B,H,L,D)
@@ -276,6 +278,8 @@ FD_SKIP = {
     "multi_sgd_mom_update": "optimizer update",
     "preloaded_multi_sgd_update": "optimizer update",
     "preloaded_multi_sgd_mom_update": "optimizer update",
+    "amp_multicast": "dtype-cast utility (gradient is identity-cast)",
+    "linalg.gelqf": "QR-based factorization; grad not defined upstream",
     "BilinearSampler": "grid-cell boundary kinks (floor of sample coords)",
 }
 

@@ -422,3 +422,69 @@ def test_im2col_col2im():
     c1 = nd.im2col(nd.array(x1), kernel=(1, 1))
     assert_almost_equal(
         nd.col2im(c1, output_size=(4, 4), kernel=(1, 1)).asnumpy(), x1)
+
+
+def test_r5_op_additions():
+    """AdaptiveAvgPooling2D / BilinearResize2D / activations / LQ /
+    maketrian / BatchNormWithReLU / getnnz / amp_multicast (r5 tail)."""
+    rng = np.random.RandomState(0)
+    x = nd.array(np.arange(2 * 3 * 4 * 6, dtype=np.float32)
+                 .reshape(2, 3, 4, 6))
+    out = nd.contrib.AdaptiveAvgPooling2D(x, output_size=(2, 3))
+    xn = x.asnumpy()
+    ref = np.zeros((2, 3, 2, 3), np.float32)
+    for i in range(2):
+        for j in range(3):
+            y0, y1 = (i * 4) // 2, -(-((i + 1) * 4) // 2)
+            x0, x1 = (j * 6) // 3, -(-((j + 1) * 6) // 3)
+            ref[:, :, i, j] = xn[:, :, y0:y1, x0:x1].mean(axis=(2, 3))
+    assert_almost_equal(out.asnumpy(), ref)
+    g = nd.contrib.AdaptiveAvgPooling2D(x, output_size=1)
+    assert_almost_equal(g.asnumpy()[:, :, 0, 0], xn.mean(axis=(2, 3)))
+
+    r = rng.randn(1, 2, 5, 7).astype(np.float32)
+    same = nd.contrib.BilinearResize2D(nd.array(r), height=5, width=7)
+    assert_almost_equal(same.asnumpy(), r, rtol=1e-5)
+    up = nd.contrib.BilinearResize2D(nd.array(r), height=9, width=13)
+    # align_corners: the corner samples are exact
+    assert_almost_equal(up.asnumpy()[0, :, 0, 0], r[0, :, 0, 0], rtol=1e-5)
+    assert_almost_equal(up.asnumpy()[0, :, -1, -1], r[0, :, -1, -1],
+                        rtol=1e-5)
+
+    xs = np.linspace(-4, 4, 9).astype(np.float32)
+    assert_almost_equal(nd.log_sigmoid(nd.array(xs)).asnumpy(),
+                        np.log(1 / (1 + np.exp(-xs))), rtol=1e-5)
+    assert_almost_equal(nd.mish(nd.array(xs)).asnumpy(),
+                        xs * np.tanh(np.log1p(np.exp(xs))), rtol=1e-4)
+
+    A = rng.randn(4, 6).astype(np.float32)
+    L, Q = nd.linalg.gelqf(nd.array(A))
+    assert_almost_equal(L.asnumpy() @ Q.asnumpy(), A, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(Q.asnumpy() @ Q.asnumpy().T, np.eye(4), atol=1e-5)
+    assert_almost_equal(np.triu(L.asnumpy(), 1), 0)   # L is lower
+
+    S = np.tril(rng.randn(4, 4)).astype(np.float32)
+    assert_almost_equal(
+        nd.linalg.maketrian(nd.linalg.extracttrian(nd.array(S))).asnumpy(),
+        S)
+
+    d = nd.array(rng.randn(2, 4, 3, 3).astype(np.float32))
+    ones, zeros = nd.array(np.ones(4, np.float32)), \
+        nd.array(np.zeros(4, np.float32))
+    o = nd.BatchNormWithReLU(d, ones, zeros, nd.array(np.zeros(4, np.float32)),
+                             nd.array(np.ones(4, np.float32)))
+    assert (o.asnumpy() >= 0).all()
+    ref_bn = nd.BatchNorm(d, ones, zeros, nd.array(np.zeros(4, np.float32)),
+                          nd.array(np.ones(4, np.float32)))
+    assert_almost_equal(o.asnumpy(), np.maximum(ref_bn.asnumpy(), 0))
+
+    z = nd.array(np.array([[1, 0, 2], [0, 0, 3]], np.float32))
+    assert int(nd.contrib.getnnz(z).asnumpy()) == 3
+    outs = nd.amp_multicast(nd.array(np.ones(3, np.float32)),
+                            nd.array(np.ones(3, np.float16)),
+                            num_outputs=2)
+    assert str(outs[0].dtype) == "float32"
+    assert str(outs[1].dtype) == "float32"
+    assert nd.contrib.boolean_mask(
+        z, nd.array(np.array([1, 0], np.float32))).shape == (1, 3)
+    assert nd.cast_storage(z, "row_sparse").stype == "row_sparse"
