@@ -63,6 +63,8 @@ def _inputs_for(name, mx):
         "Pooling": ([t(8, 16, 32, 32)], {"kernel": (2, 2), "stride": (2, 2),
                                          "pool_type": "max"}),
         "BatchNorm": ([t(8, 16, 16, 16), t(16), t(16), t(16), t(16)], {}),
+        "BatchNormWithReLU": ([t(8, 16, 16, 16), t(16), t(16), t(16),
+                               t(16)], {}),
         "LayerNorm": ([t(_N, _N), t(_N), t(_N)], {}),
         "softmax": ([t(_N, _N)], {}),
         "log_softmax": ([t(_N, _N)], {}),
