@@ -24,8 +24,14 @@ import os
 
 from .base import MXNetError
 from . import config
+from . import telemetry as _tel
 
 __all__ = ["CheckpointManager", "auto_resume"]
+
+_M_SAVE_SECONDS = _tel.histogram(
+    "mxnet_checkpoint_save_seconds", "Checkpoint save latency (blocking).")
+_M_RESTORE_SECONDS = _tel.histogram(
+    "mxnet_checkpoint_restore_seconds", "Checkpoint restore latency.")
 
 
 def _ocp():
@@ -82,9 +88,12 @@ class CheckpointManager:
             tree["trainer_states"] = np.frombuffer(blob, dtype=np.uint8)
         if not tree:
             raise MXNetError("nothing to checkpoint: pass net/trainer/extra")
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(tree),
-                               force=force)
-        self._mgr.wait_until_finished()
+        with _tel.span("checkpoint.save", "checkpoint", step=step) as sp:
+            saved = self._mgr.save(step, args=ocp.args.StandardSave(tree),
+                                   force=force)
+            self._mgr.wait_until_finished()
+        if sp is not _tel.NULL_SPAN:
+            _M_SAVE_SECONDS.observe(sp.duration_s)
         return bool(saved)
 
     def restore(self, step=None, net=None, trainer=None):
@@ -97,34 +106,39 @@ class CheckpointManager:
             step = self._mgr.latest_step()
         if step is None:
             return None, {}
-        tree = self._mgr.restore(step, args=ocp.args.StandardRestore())
-        if net is not None:
-            params = net.collect_params()
-            saved = tree.get("params", {})
-            missing = set(params.keys()) - set(saved)
-            if missing:
-                raise MXNetError(
-                    f"checkpoint step {step} lacks params {sorted(missing)}")
-            for name, p in params.items():
-                arr = _as_nd(saved[name])
-                ctxs = p.list_ctx()
-                if ctxs:
-                    arr = arr.as_in_context(ctxs[0])
-                p.set_data(arr)
-        if trainer is not None and "trainer_states" in tree:
-            import numpy as np
-            import tempfile
-            blob = np.asarray(tree["trainer_states"], dtype=np.uint8).tobytes()
-            with tempfile.NamedTemporaryFile(suffix=".states",
-                                             delete=False) as f:
-                f.write(blob)
-                path = f.name
-            try:
-                trainer._init_kvstore()
-                trainer.load_states(path)
-            finally:
-                os.unlink(path)
-        extra = {k: _as_nd(v) for k, v in tree.get("extra", {}).items()}
+        with _tel.span("checkpoint.restore", "checkpoint", step=step) as sp:
+            tree = self._mgr.restore(step, args=ocp.args.StandardRestore())
+            if net is not None:
+                params = net.collect_params()
+                saved = tree.get("params", {})
+                missing = set(params.keys()) - set(saved)
+                if missing:
+                    raise MXNetError(
+                        f"checkpoint step {step} lacks params "
+                        f"{sorted(missing)}")
+                for name, p in params.items():
+                    arr = _as_nd(saved[name])
+                    ctxs = p.list_ctx()
+                    if ctxs:
+                        arr = arr.as_in_context(ctxs[0])
+                    p.set_data(arr)
+            if trainer is not None and "trainer_states" in tree:
+                import numpy as np
+                import tempfile
+                blob = np.asarray(tree["trainer_states"],
+                                  dtype=np.uint8).tobytes()
+                with tempfile.NamedTemporaryFile(suffix=".states",
+                                                 delete=False) as f:
+                    f.write(blob)
+                    path = f.name
+                try:
+                    trainer._init_kvstore()
+                    trainer.load_states(path)
+                finally:
+                    os.unlink(path)
+            extra = {k: _as_nd(v) for k, v in tree.get("extra", {}).items()}
+        if sp is not _tel.NULL_SPAN:
+            _M_RESTORE_SECONDS.observe(sp.duration_s)
         return step, extra
 
 

@@ -41,9 +41,17 @@ KNOWN_VARS = {
         "Arrays larger than this (elements) may use reduce_scatter+all_gather "
         "instead of one psum in dist kvstore."),
     "MXNET_KVSTORE_USETREE": ("0", str, "Compat; ICI topology handled by XLA."),
-    # profiler
+    # profiler / telemetry
     "MXNET_PROFILER_AUTOSTART": ("0", int, "Start the profiler at import."),
     "MXNET_PROFILER_MODE": ("0", int, "Compat flag for storage profiling."),
+    "MXNET_TELEMETRY": (
+        "0", int,
+        "If 1, runtime telemetry (span tracer + metrics across dispatch, "
+        "kvstore, trainer, dataloader, checkpoint) records from import; "
+        "0 leaves it off until telemetry.enable()/profiler.start()."),
+    "MXNET_TELEMETRY_BUFFER": (
+        "65536", int,
+        "Span ring-buffer capacity (events); oldest events drop beyond it."),
     # data pipeline
     "MXNET_CPU_WORKER_NTHREADS": ("1", int, "Worker threads for host-side data aug."),
     # testing / RNG (reference: tests/python/unittest/common.py)

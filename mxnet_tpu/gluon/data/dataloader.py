@@ -17,10 +17,20 @@ import multiprocessing as mp
 import numpy as _np
 
 from ... import ndarray as nd
+from ... import telemetry as _tel
 from ...ndarray.ndarray import NDArray
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
 __all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+_M_BATCH_SECONDS = _tel.histogram(
+    "mxnet_dataloader_batch_seconds",
+    "Host latency to materialize one batch (fetch + batchify).")
+_M_BATCHES = _tel.counter(
+    "mxnet_dataloader_batches_total", "Batches yielded by DataLoader.")
+_M_QUEUE_DEPTH = _tel.gauge(
+    "mxnet_dataloader_queue_depth",
+    "Outstanding prefetched batches in the worker pool.")
 
 
 def default_batchify_fn(data):
@@ -92,7 +102,14 @@ class DataLoader:
     def __iter__(self):
         if self._pool is None:
             for batch_idx in self._batch_sampler:
-                yield self._batchify_fn([self._dataset[i] for i in batch_idx])
+                with _tel.span("dataloader.batch", "data",
+                               samples=len(batch_idx)) as sp:
+                    batch = self._batchify_fn(
+                        [self._dataset[i] for i in batch_idx])
+                if sp is not _tel.NULL_SPAN:
+                    _M_BATCHES.inc()
+                    _M_BATCH_SECONDS.observe(sp.duration_s)
+                yield batch
             return
         # async pool path with bounded prefetch
         results = []
@@ -112,11 +129,19 @@ class DataLoader:
         while results:
             r = results.pop(0)
             issue()
-            batch = r.get(self._timeout)
-            if isinstance(batch, tuple):
-                yield tuple(nd.array(b) for b in batch)
-            else:
-                yield nd.array(batch)
+            if _tel.enabled():
+                _M_QUEUE_DEPTH.set(len(results))
+            with _tel.span("dataloader.batch", "data",
+                           queue_depth=len(results)) as sp:
+                batch = r.get(self._timeout)
+                if isinstance(batch, tuple):
+                    out = tuple(nd.array(b) for b in batch)
+                else:
+                    out = nd.array(batch)
+            if sp is not _tel.NULL_SPAN:
+                _M_BATCHES.inc()
+                _M_BATCH_SECONDS.observe(sp.duration_s)
+            yield out
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -30,9 +30,15 @@ from __future__ import annotations
 
 from ..base import MXNetError
 from .. import optimizer as opt
+from .. import telemetry as _tel
 from .parameter import ParameterDict, Parameter
 
 __all__ = ["Trainer"]
+
+_M_STEPS = _tel.counter(
+    "mxnet_trainer_steps_total", "Optimizer steps taken by gluon.Trainer.")
+_M_STEP_SECONDS = _tel.histogram(
+    "mxnet_trainer_step_seconds", "End-to-end Trainer.step latency.")
 
 
 class Trainer:
@@ -140,30 +146,35 @@ class Trainer:
         scaler backs off (reference amp trainer flow).
         """
         self._init_kvstore()
-        scaler = getattr(self, "_amp_loss_scaler", None)
-        base_scale = getattr(self, "_amp_original_scale", self._scale)
-        scale = (base_scale if scaler is not None else self._scale) / batch_size
-        if scaler is not None:
-            if not getattr(self, "_amp_grads_unscaled", False):
-                # amp.unscale() already divided the grads in place — don't
-                # fold 1/loss_scale into the rescale a second time
-                scale /= scaler.loss_scale
-            self._amp_grads_unscaled = False
-            # overflow check BEFORE any update runs: with update_on_kvstore
-            # the store applies the optimizer inside _allreduce_grads, so a
-            # post-reduce check would be too late (inf in any replica makes
-            # the reduced grad inf, so pre-reduce detection is equivalent)
-            grads = [g for p in self._params if p.grad_req != "null"
-                     and p._data is not None for g in p.list_grad()]
-            if scaler.has_overflow(grads):
+        with _tel.span("trainer.step", "trainer", batch_size=batch_size) as sp:
+            scaler = getattr(self, "_amp_loss_scaler", None)
+            base_scale = getattr(self, "_amp_original_scale", self._scale)
+            scale = (base_scale if scaler is not None
+                     else self._scale) / batch_size
+            if scaler is not None:
+                if not getattr(self, "_amp_grads_unscaled", False):
+                    # amp.unscale() already divided the grads in place — don't
+                    # fold 1/loss_scale into the rescale a second time
+                    scale /= scaler.loss_scale
+                self._amp_grads_unscaled = False
+                # overflow check BEFORE any update runs: with update_on_kvstore
+                # the store applies the optimizer inside _allreduce_grads, so a
+                # post-reduce check would be too late (inf in any replica makes
+                # the reduced grad inf, so pre-reduce detection is equivalent)
+                grads = [g for p in self._params if p.grad_req != "null"
+                         and p._data is not None for g in p.list_grad()]
+                if scaler.has_overflow(grads):
+                    self._scale = base_scale
+                    return  # skip step; dynamic scaler backed off
+            self._optimizer.rescale_grad = scale
+            self._allreduce_grads()
+            if not self._update_on_kvstore:
+                self._update(ignore_stale_grad)
+            if scaler is not None:
                 self._scale = base_scale
-                return  # skip step; dynamic scaler backed off
-        self._optimizer.rescale_grad = scale
-        self._allreduce_grads()
-        if not self._update_on_kvstore:
-            self._update(ignore_stale_grad)
-        if scaler is not None:
-            self._scale = base_scale
+        if sp is not _tel.NULL_SPAN:
+            _M_STEPS.inc()
+            _M_STEP_SECONDS.observe(sp.duration_s)
 
     def allreduce_grads(self):
         self._init_kvstore()
@@ -176,17 +187,19 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, p in enumerate(self._params):
-            if p.grad_req == "null":
-                continue
-            grads = p.list_grad()
-            self._kvstore.push(i, grads if len(grads) > 1 else grads[0])
-            if self._update_on_kvstore:
-                # store ran the optimizer; pull updated weights to replicas
-                datas = p.list_data()
-                self._kvstore.pull(i, datas if len(datas) > 1 else datas[0])
-            else:
-                self._kvstore.pull(i, grads if len(grads) > 1 else grads[0])
+        with _tel.span("trainer.allreduce", "trainer",
+                       update_on_kvstore=self._update_on_kvstore):
+            for i, p in enumerate(self._params):
+                if p.grad_req == "null":
+                    continue
+                grads = p.list_grad()
+                self._kvstore.push(i, grads if len(grads) > 1 else grads[0])
+                if self._update_on_kvstore:
+                    # store ran the optimizer; pull updated weights to replicas
+                    datas = p.list_data()
+                    self._kvstore.pull(i, datas if len(datas) > 1 else datas[0])
+                else:
+                    self._kvstore.pull(i, grads if len(grads) > 1 else grads[0])
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._init_kvstore()
@@ -198,6 +211,10 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):  # noqa: ARG002
+        with _tel.span("trainer.optimizer", "trainer"):
+            self._update_impl()
+
+    def _update_impl(self):
         optzr = self._optimizer
         agg = getattr(optzr, "aggregate_num", 0)
         if agg > 1 and len(self._updaters) == 1 \

@@ -27,7 +27,18 @@ import os
 from ..base import MXNetError
 from .. import config
 from .. import ndarray as nd
+from .. import telemetry as _tel
 from .local import KVStoreLocal
+
+# registry get-or-create: same handles local.py registered
+_M_PUSH_BYTES = _tel.counter("mxnet_kvstore_push_bytes_total")
+_M_PUSH_SECONDS = _tel.histogram("mxnet_kvstore_push_seconds")
+_M_ALLREDUCE_BYTES = _tel.counter(
+    "mxnet_kvstore_allreduce_bytes_total",
+    "Bytes entering the cross-process allreduce collective.")
+_M_ALLREDUCE_SECONDS = _tel.histogram(
+    "mxnet_kvstore_allreduce_seconds",
+    "Cross-process allreduce latency (dispatch + transfer).")
 
 
 def _merge_rowsparse(vals):
@@ -205,10 +216,17 @@ class KVStoreDistTPUSync(KVStoreLocal):
         if jax.process_count() <= 1:
             return arr
         import jax.numpy as jnp
-        garr = self._make_global(arr)
-        out = self._psum_fn(arr.shape, arr.dtype)(garr)
-        # fully replicated output: this process reads its local copy
-        return jnp.asarray(out.addressable_data(0))
+        with _tel.span("kvstore.allreduce", "kvstore") as span_:
+            if span_ is not _tel.NULL_SPAN:
+                span_.set(bytes=int(arr.nbytes))
+            garr = self._make_global(arr)
+            out = self._psum_fn(arr.shape, arr.dtype)(garr)
+            # fully replicated output: this process reads its local copy
+            res = jnp.asarray(out.addressable_data(0))
+        if span_ is not _tel.NULL_SPAN:
+            _M_ALLREDUCE_SECONDS.observe(span_.duration_s)
+            _M_ALLREDUCE_BYTES.inc(int(arr.nbytes))
+        return res
 
     def _make_global(self, arr):
         """Local (\\*shape) value → global (P, \\*shape) array whose p-th
@@ -262,28 +280,36 @@ class KVStoreDistTPUSync(KVStoreLocal):
         # NOTE: local replica reduction only — per-process compression and
         # the cross-process wire step happen below, once, so super().push
         # must not re-compress (we call _store_merged directly)
-        merged = self._reduce(value if isinstance(value, (list, tuple))
-                              else [value])
-        from ..ndarray import sparse as sp
-        if isinstance(merged, sp.BaseSparseNDArray):
-            self._store_merged(key, merged)
-            return
-        import jax
-        if self._compression is not None and jax.process_count() > 1:
-            # 2-bit wire path: all-gather the PACKED codes (16x less DCN
-            # traffic than f32 — reference kvstore_dist.h quantized push),
-            # then each process dequantizes every contribution and sums
-            packed, shape, dtype = self._compression.compress(
-                key, "dist", merged._data)
-            gathered = self._gather_packed(packed)
-            total = self._compression.decompress_sum(gathered, shape, dtype)
-            reduced = nd.NDArray._from_data(total, ctx=merged.ctx)
-        else:
-            if self._compression is not None:
-                merged = self._compress_values(key, merged)
-            reduced = nd.NDArray._from_data(self._allreduce(merged._data),
-                                            ctx=merged.ctx)
-        self._store_merged(key, reduced)
+        with _tel.span("kvstore.push", "kvstore") as span_:
+            if span_ is not _tel.NULL_SPAN:
+                span_.set(key=str(key), bytes=_tel.payload_bytes(value))
+            merged = self._reduce(value if isinstance(value, (list, tuple))
+                                  else [value])
+            from ..ndarray import sparse as sp
+            if isinstance(merged, sp.BaseSparseNDArray):
+                self._store_merged(key, merged)
+            else:
+                import jax
+                if self._compression is not None and jax.process_count() > 1:
+                    # 2-bit wire path: all-gather the PACKED codes (16x less
+                    # DCN traffic than f32 — reference kvstore_dist.h
+                    # quantized push), then each process dequantizes every
+                    # contribution and sums
+                    packed, shape, dtype = self._compression.compress(
+                        key, "dist", merged._data)
+                    gathered = self._gather_packed(packed)
+                    total = self._compression.decompress_sum(
+                        gathered, shape, dtype)
+                    reduced = nd.NDArray._from_data(total, ctx=merged.ctx)
+                else:
+                    if self._compression is not None:
+                        merged = self._compress_values(key, merged)
+                    reduced = nd.NDArray._from_data(
+                        self._allreduce(merged._data), ctx=merged.ctx)
+                self._store_merged(key, reduced)
+        if span_ is not _tel.NULL_SPAN:
+            _M_PUSH_SECONDS.observe(span_.duration_s)
+            _M_PUSH_BYTES.inc(span_.attrs.get("bytes", 0))
 
     def _gather_packed(self, packed):
         """(nbytes,) uint8 local codes → (P, nbytes) from every process."""

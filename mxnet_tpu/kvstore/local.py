@@ -12,9 +12,20 @@ from __future__ import annotations
 
 from ..base import MXNetError
 from .. import ndarray as nd
+from .. import telemetry as _tel
 from ..ndarray.ndarray import NDArray
 from ..ndarray import sparse as sp
 from .base import KVStoreBase
+
+# bytes-moved counters + call-latency histograms (ISSUE 1: comms visibility)
+_M_PUSH_BYTES = _tel.counter(
+    "mxnet_kvstore_push_bytes_total", "Bytes pushed into the kvstore.")
+_M_PULL_BYTES = _tel.counter(
+    "mxnet_kvstore_pull_bytes_total", "Bytes pulled out of the kvstore.")
+_M_PUSH_SECONDS = _tel.histogram(
+    "mxnet_kvstore_push_seconds", "kvstore push call latency.")
+_M_PULL_SECONDS = _tel.histogram(
+    "mxnet_kvstore_pull_seconds", "kvstore pull call latency.")
 
 
 def _is_list(v):
@@ -93,8 +104,14 @@ class KVStoreLocal(KVStoreBase):
             key = key[0]
         if key not in self._store:
             raise MXNetError(f"key {key!r} not initialized")
-        merged = self._reduce(self._compress_values(key, value))
-        self._store_merged(key, merged)
+        with _tel.span("kvstore.push", "kvstore") as span_:
+            if span_ is not _tel.NULL_SPAN:
+                span_.set(key=str(key), bytes=_tel.payload_bytes(value))
+            merged = self._reduce(self._compress_values(key, value))
+            self._store_merged(key, merged)
+        if span_ is not _tel.NULL_SPAN:
+            _M_PUSH_SECONDS.observe(span_.duration_s)
+            _M_PUSH_BYTES.inc(span_.attrs.get("bytes", 0))
 
     def _store_merged(self, key, merged):
         """Post-reduction store/update step (shared with the dist store)."""
@@ -118,16 +135,23 @@ class KVStoreLocal(KVStoreBase):
             key = key[0]
         if key not in self._store:
             raise MXNetError(f"key {key!r} not initialized")
-        stored = self._store[key]
-        if isinstance(stored, sp.BaseSparseNDArray):
-            stored = stored.tostype("default")
-        outs = out if _is_list(out) else [out]
-        import jax
-        for o in outs:
-            arr = stored._data
-            if o.ctx != stored.ctx:
-                arr = jax.device_put(arr, o.ctx.jax_device())
-            o._set_data(arr)
+        with _tel.span("kvstore.pull", "kvstore") as span_:
+            stored = self._store[key]
+            if isinstance(stored, sp.BaseSparseNDArray):
+                stored = stored.tostype("default")
+            outs = out if _is_list(out) else [out]
+            import jax
+            for o in outs:
+                arr = stored._data
+                if o.ctx != stored.ctx:
+                    arr = jax.device_put(arr, o.ctx.jax_device())
+                o._set_data(arr)
+            if span_ is not _tel.NULL_SPAN:
+                span_.set(key=str(key),
+                          bytes=_tel.payload_bytes(stored) * len(outs))
+        if span_ is not _tel.NULL_SPAN:
+            _M_PULL_SECONDS.observe(span_.duration_s)
+            _M_PULL_BYTES.inc(span_.attrs.get("bytes", 0))
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):  # noqa: ARG002
         if row_ids is None:

@@ -23,9 +23,12 @@ from __future__ import annotations
 
 import functools
 import threading
+import time as _time
 
 from ..base import MXNetError
 from .. import config, engine
+from .. import telemetry as _telemetry
+from ..telemetry import tracer as _ttrace
 
 __all__ = ["Op", "register", "get", "list_ops", "invoke", "invoke_arrays"]
 
@@ -267,12 +270,8 @@ def invoke(op, inputs, attrs=None, out=None, ctx=None):
     if op.wrap_train is not None and op.wrap_train not in attrs:
         attrs[op.wrap_train] = autograd.is_training()
 
-    import sys as _sys
-    _prof = _sys.modules.get("mxnet_tpu.profiler")
-    _t0 = None
-    if _prof is not None and _prof.is_running():
-        import time as _time
-        _t0 = _time.perf_counter()
+    # telemetry gate: exactly one module-attribute check on the disabled path
+    _t0 = _time.perf_counter_ns() if _ttrace._ENABLED else None
 
     recording = autograd.is_recording() and op.differentiable
     if recording:
@@ -298,14 +297,18 @@ def invoke(op, inputs, attrs=None, out=None, ctx=None):
 
     out_arrays = _normalize_out(op, out_raw)
     engine.on_dispatch(out_arrays)
+    _hook_ns = 0
     if _monitor_hooks:
+        _h0 = _time.perf_counter_ns() if _t0 is not None else 0
         for _h in _monitor_hooks:
             _h(op.name, out_arrays)
+        if _t0 is not None:
+            _hook_ns = _time.perf_counter_ns() - _h0
 
     if _t0 is not None:
-        import time as _time
         # host dispatch time; device time lives in the XLA trace (N20 split)
-        _prof.record_op(op.name, _time.perf_counter() - _t0)
+        _telemetry.record_dispatch(op.name, _t0, _time.perf_counter_ns(),
+                                   _hook_ns)
 
     # mutate_inputs ops (running stats etc.): write back into input slots
     for out_idx, in_idx in op.mutate_inputs:

@@ -1,0 +1,105 @@
+"""mx.telemetry — unified runtime observability.
+
+Three pieces (ISSUE 1 tentpole; reference anchors: src/profiler/profiler.cc
+Chrome-trace writer + aggregate_stats.cc per-op table):
+
+- **spans** (`tracer`) — ``telemetry.span(name, category, **attrs)`` context
+  manager recording begin/end host timestamps into a ring buffer;
+  ``chrome_trace()`` exports genuine Chrome-trace JSON (``traceEvents`` with
+  ``ph:"X"``, ``pid``/``tid``, ``cat``, ``args``) for chrome://tracing.
+- **metrics** (`metrics`) — process-global Counter/Gauge/Histogram registry
+  with Prometheus-text and JSON exporters.
+- **ledger** (`ledger`) — the per-op aggregate table mx.profiler renders.
+
+Instrumentation ships wired into the runtime chokepoints: op dispatch
+(ops.registry), kvstore push/pull/allreduce, gluon.Trainer step phases,
+DataLoader batch fetch, and checkpoint save/load.  Everything is gated on
+one flag: ``MXNET_TELEMETRY=1`` in the environment, ``telemetry.enable()``
+at runtime, or implicitly via ``mx.profiler.start()``.  When the flag is
+off, the dispatch hot path pays exactly one module-attribute check and the
+non-hot paths one no-op span; nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+from .. import config
+from . import ledger, metrics, tracer
+from .ledger import record_op
+from .metrics import (  # noqa: F401
+    DEFAULT_BUCKETS, REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
+    counter, gauge, histogram, to_json, to_prometheus,
+)
+from .tracer import (  # noqa: F401
+    NULL_SPAN, Span, Tracer, chrome_trace, disable, enable, enabled,
+    get_tracer, instant, span,
+)
+
+__all__ = [
+    "span", "instant", "enable", "disable", "enabled", "get_tracer",
+    "chrome_trace", "clear",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "counter", "gauge", "histogram", "to_prometheus", "to_json",
+    "DEFAULT_BUCKETS",
+    "record_op", "record_dispatch", "ledger", "metrics", "tracer",
+    "env_enabled",
+]
+
+# -- dispatch instrumentation (fed by ops.registry.invoke) -------------------
+# Handles are created once; the hot path only observes into them.
+
+_OP_COUNT = counter(
+    "mxnet_op_dispatch_total", "Imperative op dispatches through ops.registry.")
+_OP_SECONDS = histogram(
+    "mxnet_op_dispatch_seconds", "Host-side dispatch latency per op.")
+_HOOK_SECONDS = histogram(
+    "mxnet_monitor_hook_seconds", "Monitor-hook overhead per dispatch.")
+
+
+def record_dispatch(name, begin_ns, end_ns, hook_ns=0):
+    """One imperative dispatch: counter + latency histogram + trace event +
+    ledger row.  Callers gate on ``tracer._ENABLED`` so the disabled hot
+    path never reaches here."""
+    dt_s = (end_ns - begin_ns) / 1e9
+    _OP_COUNT.inc()
+    _OP_SECONDS.observe(dt_s)
+    if hook_ns:
+        _HOOK_SECONDS.observe(hook_ns / 1e9)
+    tracer.get_tracer().add_event(name, "dispatch", begin_ns, end_ns)
+    ledger.record_op(name, dt_s)
+
+
+def clear():
+    """Drop buffered trace events and ledger rows (metrics keep counting —
+    use REGISTRY.reset() to zero them)."""
+    tracer.clear()
+    ledger.clear()
+
+
+def payload_bytes(value):
+    """Best-effort byte size of an NDArray / jax array / (nested) list —
+    used by the kvstore bytes-moved counters."""
+    if isinstance(value, (list, tuple)):
+        return sum(payload_bytes(v) for v in value)
+    data = getattr(value, "_data", value)
+    n = getattr(data, "nbytes", None)
+    if n is not None:
+        return int(n)
+    # sparse NDArrays: data + indices ride separately
+    total = 0
+    for part in (getattr(value, "data", None), getattr(value, "indices", None)):
+        if part is not None:
+            total += payload_bytes(part)
+    return total
+
+
+# -- env switch --------------------------------------------------------------
+
+_ENV_ENABLED = bool(config.get_int("MXNET_TELEMETRY", 0))
+if _ENV_ENABLED:
+    enable()
+
+
+def env_enabled():
+    """True when MXNET_TELEMETRY turned telemetry on at import — the
+    profiler facade then never turns it off on stop()."""
+    return _ENV_ENABLED

@@ -1,0 +1,250 @@
+"""Metrics registry — Counter / Gauge / Histogram with text + JSON export.
+
+The machine-readable half of mx.telemetry (the reference's
+``aggregate_stats.cc`` table is human-only).  Metrics are process-global,
+get-or-create by name, thread-safe, and export in two forms:
+
+- ``to_prometheus()`` — the Prometheus text exposition format (``# HELP`` /
+  ``# TYPE`` lines, ``_bucket{le="..."}`` cumulative histogram rows), so a
+  scrape endpoint or a log line is one call away;
+- ``to_json()`` — a plain dict for programmatic assertions and BENCH_* runs.
+
+Stdlib-only; safe to import anywhere.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+           "counter", "gauge", "histogram", "to_prometheus", "to_json",
+           "DEFAULT_BUCKETS"]
+
+# Latency-oriented defaults (seconds): 10us .. 10s, the span of one host
+# dispatch up to one full checkpoint write.
+DEFAULT_BUCKETS = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+    1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Counter:
+    """Monotonically increasing count (ops dispatched, bytes moved)."""
+
+    kind = "counter"
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name, help=""):  # noqa: A002 — prometheus field name
+        self.name = name
+        self.help = help
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, amount=1):
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        return self._value
+
+    def _reset(self):
+        with self._lock:
+            self._value = 0
+
+    def snapshot(self):
+        return {"type": self.kind, "help": self.help, "value": self._value}
+
+    def render(self, lines):
+        lines.append(f"{self.name} {self._value}")
+
+
+class Gauge:
+    """Point-in-time value (queue depth, loss scale)."""
+
+    kind = "gauge"
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name, help=""):  # noqa: A002
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+
+    def inc(self, amount=1):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount=1):
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self):
+        return self._value
+
+    def _reset(self):
+        with self._lock:
+            self._value = 0.0
+
+    def snapshot(self):
+        return {"type": self.kind, "help": self.help, "value": self._value}
+
+    def render(self, lines):
+        lines.append(f"{self.name} {self._value}")
+
+
+class Histogram:
+    """Distribution over fixed bucket boundaries (latency histograms).
+
+    ``buckets`` are upper bounds in ascending order; an implicit +Inf bucket
+    catches the tail.  Export follows Prometheus cumulative-bucket semantics.
+    """
+
+    kind = "histogram"
+    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_count",
+                 "_lock")
+
+    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS):  # noqa: A002
+        self.name = name
+        self.help = help
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket boundary")
+        self.buckets = bounds
+        self._counts = [0] * (len(bounds) + 1)  # last slot = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value):
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def _reset(self):
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+    def snapshot(self):
+        with self._lock:
+            counts = list(self._counts)
+            total, ssum = self._count, self._sum
+        cum, buckets = 0, {}
+        for bound, c in zip(self.buckets, counts):
+            cum += c
+            buckets[bound] = cum
+        return {"type": self.kind, "help": self.help, "buckets": buckets,
+                "sum": ssum, "count": total}
+
+    def render(self, lines):
+        snap = self.snapshot()
+        for bound, cum in snap["buckets"].items():
+            lines.append(f'{self.name}_bucket{{le="{bound:g}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {snap["count"]}')
+        lines.append(f"{self.name}_sum {snap['sum']}")
+        lines.append(f"{self.name}_count {snap['count']}")
+
+
+class MetricsRegistry:
+    """Get-or-create home for all metrics; one per process by default."""
+
+    def __init__(self):
+        self._metrics: dict = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name, help, **kwargs):  # noqa: A002
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name, help=""):  # noqa: A002
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name, help=""):  # noqa: A002
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name, help="", buckets=DEFAULT_BUCKETS):  # noqa: A002
+        h = self._get_or_create(Histogram, name, help, buckets=buckets)
+        want = tuple(sorted(float(b) for b in buckets))
+        if h.buckets != want:
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{h.buckets}, requested {want}")
+        return h
+
+    def get(self, name):
+        return self._metrics.get(name)
+
+    def collect(self):
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def reset(self):
+        """Zero every metric in place (handles stay valid — instrumented
+        modules hold module-level references)."""
+        for m in self.collect():
+            m._reset()
+
+    def to_prometheus(self):
+        lines = []
+        for m in self.collect():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            m.render(lines)
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_json(self, indent=None):
+        return json.dumps({m.name: m.snapshot() for m in self.collect()},
+                          indent=indent, sort_keys=True)
+
+
+REGISTRY = MetricsRegistry()
+
+
+def counter(name, help=""):  # noqa: A002
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name, help=""):  # noqa: A002
+    return REGISTRY.gauge(name, help)
+
+
+def histogram(name, help="", buckets=DEFAULT_BUCKETS):  # noqa: A002
+    return REGISTRY.histogram(name, help, buckets=buckets)
+
+
+def to_prometheus():
+    return REGISTRY.to_prometheus()
+
+
+def to_json(indent=None):
+    return REGISTRY.to_json(indent=indent)
