@@ -41,6 +41,12 @@ KNOWN_VARS = {
         "Arrays larger than this (elements) may use reduce_scatter+all_gather "
         "instead of one psum in dist kvstore."),
     "MXNET_KVSTORE_USETREE": ("0", str, "Compat; ICI topology handled by XLA."),
+    "MXNET_KVSTORE_BUCKET_MB": (
+        "25", float,
+        "Gradient-fusion bucket size (MB) for kvstore pushpull_list: dense "
+        "uncompressed grads flatten-concat into buckets of at most this many "
+        "bytes and reduce with ONE dispatch per bucket (DDP/Horovod-style "
+        "fusion). 0 disables fusion (per-key pushpull, bit-identical)."),
     # profiler / telemetry
     "MXNET_PROFILER_AUTOSTART": ("0", int, "Start the profiler at import."),
     "MXNET_PROFILER_MODE": ("0", int, "Compat flag for storage profiling."),

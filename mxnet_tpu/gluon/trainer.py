@@ -189,17 +189,39 @@ class Trainer:
             return
         with _tel.span("trainer.allreduce", "trainer",
                        update_on_kvstore=self._update_on_kvstore):
+            if self._update_on_kvstore:
+                # per-key: the store runs the optimizer inside push and pull
+                # broadcasts the updated WEIGHTS (no fused analog — the
+                # fusion layer reduces gradients only)
+                for i, p in enumerate(self._params):
+                    if p.grad_req == "null":
+                        continue
+                    grads = p.list_grad()
+                    self._kvstore.push(i, grads if len(grads) > 1
+                                       else grads[0])
+                    datas = p.list_data()
+                    self._kvstore.pull(i, datas if len(datas) > 1
+                                       else datas[0])
+                return
+            # dense path: hand the WHOLE grad list to the kvstore in one
+            # call; it buckets dense uncompressed keys into flat buffers
+            # (kvstore/fusion.py) and falls back per key for the rest,
+            # bit-identically
+            keys, vals = [], []
             for i, p in enumerate(self._params):
                 if p.grad_req == "null":
                     continue
                 grads = p.list_grad()
-                self._kvstore.push(i, grads if len(grads) > 1 else grads[0])
-                if self._update_on_kvstore:
-                    # store ran the optimizer; pull updated weights to replicas
-                    datas = p.list_data()
-                    self._kvstore.pull(i, datas if len(datas) > 1 else datas[0])
-                else:
-                    self._kvstore.pull(i, grads if len(grads) > 1 else grads[0])
+                keys.append(i)
+                vals.append(grads if len(grads) > 1 else grads[0])
+            if not keys:
+                return
+            if hasattr(self._kvstore, "pushpull_list"):
+                self._kvstore.pushpull_list(keys, vals, vals)
+            else:  # duck-typed store: reference per-key push+pull
+                for k, v in zip(keys, vals):
+                    self._kvstore.push(k, v)
+                    self._kvstore.pull(k, v)
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._init_kvstore()

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 from ..base import MXNetError
 from .base import KVStoreBase  # noqa: F401
+from . import fusion  # noqa: F401  (GradBucketer — ISSUE 2 gradient fusion)
 from .local import KVStoreLocal
 from .dist import KVStoreDistTPUSync
 

@@ -59,3 +59,11 @@ class KVStoreBase:
 
     def pushpull(self, key, value, out=None, priority=0):
         raise NotImplementedError
+
+    def pushpull_list(self, keys, values, outs, priority=0):
+        """Multi-key pushpull in one call — the gradient-fusion entry point
+        (Trainer._allreduce_grads routes its whole dense grad list here).
+        Base implementation: the plain per-key loop; KVStoreLocal overrides
+        it with bucketed flat-buffer fusion (kvstore/fusion.py)."""
+        for k, v, o in zip(keys, values, outs):
+            self.pushpull(k, v, out=o, priority=priority)

@@ -311,6 +311,25 @@ class KVStoreDistTPUSync(KVStoreLocal):
             _M_PUSH_SECONDS.observe(span_.duration_s)
             _M_PUSH_BYTES.inc(span_.attrs.get("bytes", 0))
 
+    # -- fused multi-key path (ISSUE 2): one psum per BUCKET ----------------
+    def _fusable(self, key, vlist):
+        # sparse-PS keys take the host KV service; everything else follows
+        # the local rules (dense, uncompressed)
+        return super()._fusable(key, vlist) and not self._is_sparse_key(key)
+
+    def _allreduce_flat(self, flat):
+        # the whole bucket crosses processes as ONE collective — at BERT
+        # scale that is ~17 psums per step instead of ~200
+        return self._allreduce(flat)
+
+    def _fused_needs_flat(self):
+        import jax
+        return jax.process_count() > 1
+
+    def pushpull_list(self, keys, values, outs, priority=0):
+        self._ensure_dist()
+        return super().pushpull_list(keys, values, outs, priority=priority)
+
     def _gather_packed(self, packed):
         """(nbytes,) uint8 local codes → (P, nbytes) from every process."""
         import jax.numpy as jnp

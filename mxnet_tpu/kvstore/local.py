@@ -10,11 +10,15 @@ sum; ``row_sparse_pull(row_ids)`` retains only requested rows).
 
 from __future__ import annotations
 
+import time as _time
+
 from ..base import MXNetError
 from .. import ndarray as nd
 from .. import telemetry as _tel
 from ..ndarray.ndarray import NDArray
 from ..ndarray import sparse as sp
+from ..telemetry import tracer as _ttrace
+from . import fusion
 from .base import KVStoreBase
 
 # bytes-moved counters + call-latency histograms (ISSUE 1: comms visibility)
@@ -39,6 +43,10 @@ class KVStoreLocal(KVStoreBase):
         self._updater = None
         self._optimizer = None
         self._compression = None
+        # gradient fusion (ISSUE 2): dense pushpull_list keys are bucketed
+        # into flat buffers of at most this many bytes; <= 0 disables
+        self._bucket_bytes = fusion.bucket_bytes_from_env()
+        self._bucketer = None  # lazy GradBucketer (holds executable caches)
 
     @property
     def type(self):
@@ -61,11 +69,14 @@ class KVStoreLocal(KVStoreBase):
         if isinstance(values[0], sp.RowSparseNDArray):
             return self._reduce_rowsparse(values)
         # per-device replicas are committed to their devices; stage onto the
-        # first value's device then sum — one XLA add chain (CommDevice role)
-        out = values[0]
-        for v in values[1:]:
-            out = out + v.as_in_context(out.ctx)
-        return out
+        # first value's device and sum with a pairwise tree — O(log n) depth
+        # instead of the former sequential O(n) add chain (CommDevice role),
+        # and the SAME fixed-association adds the fused bucket executables
+        # run, which is what keeps fused and per-key results bit-identical.
+        ctx0 = values[0].ctx
+        arrs = [values[0]._data] + [v.as_in_context(ctx0)._data
+                                    for v in values[1:]]
+        return NDArray._from_data(fusion.tree_sum(arrs), ctx=ctx0)
 
     @staticmethod
     def _reduce_rowsparse(values):
@@ -172,6 +183,111 @@ class KVStoreLocal(KVStoreBase):
         self.push(key, value, priority)
         if out is not None:
             self.pull(key, out, priority)
+
+    # -- fused multi-key path (ISSUE 2 tentpole; kvstore/fusion.py) ----------
+    def set_bucket_size(self, mb):
+        """Resize the fusion bucket bound (MB); 0 disables fusion.  Resets
+        the bucketer so cached plans rebuild against the new bound."""
+        self._bucket_bytes = int(float(mb) * (1 << 20))
+        self._bucketer = None
+
+    def _fusable(self, key, vlist):
+        """A key may enter a bucket only if its reduce+store+pull composes
+        to exactly the per-key path: dense stored value, dense pushed
+        values, no gradient compression (subclasses add their own vetoes)."""
+        if self._compression is not None:
+            return False
+        stored = self._store.get(key)
+        if stored is None or isinstance(stored, sp.BaseSparseNDArray):
+            return False
+        return not any(isinstance(v, sp.BaseSparseNDArray) for v in vlist)
+
+    def _allreduce_flat(self, flat):
+        """Cross-worker reduction of one flat bucket; identity in-process
+        (the dist store overrides this with one psum per bucket)."""
+        return flat
+
+    def _fused_needs_flat(self):
+        """True when buckets must flatten into one buffer for a cross-worker
+        wire step (dist store, multi-process).  In-process the flat buffer
+        is pure memcpy overhead, so buckets reduce per-key in one dispatch
+        instead."""
+        return False
+
+    def pushpull_list(self, keys, values, outs, priority=0):
+        if self._updater is not None or self._bucket_bytes <= 0:
+            # update-on-kvstore runs the optimizer inside push — the fused
+            # path has no update hook, so take the per-key loop verbatim
+            return KVStoreBase.pushpull_list(self, keys, values, outs,
+                                             priority=priority)
+        fused, fallback, vlists = [], [], []
+        for j, key in enumerate(keys):
+            v = values[j]
+            vlist = list(v) if _is_list(v) else [v]
+            vlists.append(vlist)
+            (fused if self._fusable(key, vlist) else fallback).append(j)
+        for j in fallback:
+            self.pushpull(keys[j], values[j], out=outs[j], priority=priority)
+        if _ttrace._ENABLED:
+            fusion.record_fallback(len(fallback))
+        if fused:
+            self._fused_pushpull([keys[j] for j in fused],
+                                 [vlists[j] for j in fused],
+                                 [outs[j] for j in fused])
+
+    def _fused_pushpull(self, keys, vlists, outs):
+        import jax
+        bucketer = self._bucketer
+        if bucketer is None:
+            bucketer = self._bucketer = fusion.GradBucketer(self._bucket_bytes)
+        signature = tuple((tuple(v[0].shape), str(v[0].dtype), len(v))
+                          for v in vlists)
+        buckets = bucketer.plan(signature)
+        needs_flat = self._fused_needs_flat()
+        enabled = _ttrace._ENABLED
+        with _tel.span("kvstore.fused_pushpull", "kvstore") as span_:
+            total_bytes = 0
+            for b in buckets:
+                t0 = _time.perf_counter_ns() if enabled else 0
+                prim_ctx = vlists[b.positions[0]][0].ctx
+                prim_dev = None  # resolved lazily; staging is the rare case
+                arrays = []
+                for r in range(b.n_rep):
+                    for p in b.positions:
+                        v = vlists[p][r]
+                        a = v._data
+                        if v.ctx != prim_ctx:
+                            if prim_dev is None:
+                                prim_dev = prim_ctx.jax_device()
+                            a = jax.device_put(a, prim_dev)
+                        arrays.append(a)
+                if needs_flat:
+                    # wire strategy: one flat buffer → ONE collective/bucket
+                    flat = bucketer.reduce_flat(b, arrays)
+                    flat = self._allreduce_flat(flat)
+                    parts = bucketer.unflatten(b, flat)
+                elif b.n_rep == 1:
+                    parts = arrays  # identity reduction: zero device work
+                else:
+                    parts = bucketer.reduce_bucket(b, arrays)
+                for p, arr in zip(b.positions, parts):
+                    self._store[keys[p]]._set_data(arr)
+                    o = outs[p]
+                    for out_nd in (o if _is_list(o) else [o]):
+                        if out_nd is None:
+                            continue
+                        oarr = arr
+                        if out_nd.ctx != prim_ctx:
+                            oarr = jax.device_put(arr,
+                                                  out_nd.ctx.jax_device())
+                        out_nd._set_data(oarr)
+                if enabled:
+                    fusion.record_bucket(b, _time.perf_counter_ns() - t0)
+                    total_bytes += b.nbytes * b.n_rep
+            if enabled:
+                fusion.record_pushpull()
+                span_.set(keys=len(keys), buckets=len(buckets),
+                          bytes=total_bytes)
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)

@@ -192,6 +192,18 @@ def _freeze(v):
     return v
 
 
+# memo over the FULL _callable_for result: on the hot path (telemetry off,
+# attrs hashable) a repeat invoke is one tuple build + dict probe instead of
+# re-freezing attrs and rebuilding wrapper/partial closures per call.  Only
+# ops interned in _REGISTRY participate — transient Op objects (numpy
+# wrappers, autograd backward replays, CachedOp) carry per-instance
+# closures that must never outlive them.  Keys with unhashable attr values
+# (PRNG keys, traced arrays, list attrs) also skip the memo and take the
+# build path, which handles them via _freeze/TypeError.
+_callable_memo: dict = {}
+_CALLABLE_MEMO_MAX = 1024
+
+
 def _callable_for(op, attrs):
     """A positional-only callable with attrs bound, jitted when enabled.
 
@@ -199,11 +211,31 @@ def _callable_for(op, attrs):
     jit arguments (one compile covers all their values); everything else is a
     static part of the cache key.
     """
+    jit_on = op.jit and config.get_int("MXNET_TPU_JIT_IMPERATIVE", 1)
+    mkey = None
+    if _REGISTRY.get(op.name) is op:  # interned op: stable identity
+        try:
+            mkey = (op.name, jit_on,
+                    tuple(attrs.items()) if attrs else None)
+            f = _callable_memo.get(mkey)
+            if f is not None:
+                return f
+        except TypeError:
+            mkey = None
+    f = _build_callable(op, attrs, jit_on)
+    if mkey is not None:
+        if len(_callable_memo) >= _CALLABLE_MEMO_MAX:
+            _callable_memo.clear()
+        _callable_memo[mkey] = f
+    return f
+
+
+def _build_callable(op, attrs, jit_on):
     dyn = {k: attrs[k] for k in op.dynamic_attrs
            if k in attrs and isinstance(attrs[k], (int, float))
            and not isinstance(attrs[k], bool)}
     static = {k: v for k, v in attrs.items() if k not in dyn}
-    if not (op.jit and config.get_int("MXNET_TPU_JIT_IMPERATIVE", 1)):
+    if not jit_on:
         return functools.partial(op.fn, **attrs) if attrs else op.fn
     dyn_keys = tuple(sorted(dyn))
     key = (op.name, _freeze(static), dyn_keys)

@@ -60,7 +60,22 @@ def main():
     np.testing.assert_allclose(outs[0].asnumpy(), 3.0)
     np.testing.assert_allclose(outs[1].asnumpy(), 11.0)  # 6 + 5
 
-    # 5. 2-bit compression over the wire (packed allgather path):
+    # 5. fused pushpull_list (ISSUE 2): the whole key list buckets into
+    #    flat buffers and crosses processes as ONE psum per bucket
+    kv.init([20, 21, 22], [mx.nd.zeros((3,)), mx.nd.zeros((2, 2)),
+                           mx.nd.zeros((5,))])
+    for rnd in range(2):  # second round re-uses the cached plan/executables
+        vals = [mx.nd.ones((3,)) * (rank + 1 + rnd),
+                mx.nd.ones((2, 2)) * (rank + 2 + rnd),
+                mx.nd.ones((5,)) * (rank + 3 + rnd)]
+        outs = [mx.nd.zeros((3,)), mx.nd.zeros((2, 2)), mx.nd.zeros((5,))]
+        kv.pushpull_list([20, 21, 22], vals, outs)
+        np.testing.assert_allclose(outs[0].asnumpy(), 3.0 + 2 * rnd)
+        np.testing.assert_allclose(outs[1].asnumpy(), 5.0 + 2 * rnd)
+        np.testing.assert_allclose(outs[2].asnumpy(), 7.0 + 2 * rnd)
+    assert kv._bucketer is not None and kv._bucketer.builds == 2  # 1 bucket
+
+    # 6. 2-bit compression over the wire (packed allgather path):
     #    rank0 pushes +0.7 (→ +t), rank1 pushes -0.6 (→ -t); sum == 0;
     #    second round consumes the residuals (0.2, -0.1): 0.2+0.4 → +t,
     #    -0.1-0.3 < -t/…? -0.4 → 0  ⇒ sum == +t
