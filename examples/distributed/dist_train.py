@@ -34,6 +34,12 @@ import jax
 if os.environ.get("JAX_PLATFORMS") == "cpu":
     # the axon PJRT plugin overrides the env var; pin through jax.config
     jax.config.update("jax_platforms", "cpu")
+    # multi-process computations on the CPU backend need a host
+    # collectives implementation; must precede backend initialization
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass  # older jaxlib without gloo
 
 if "MXNET_DIST_COORDINATOR" in os.environ:
     # distributed init MUST precede backend init (jax.distributed contract)

@@ -81,7 +81,7 @@ def __getattr__(name):
               "util", "numpy", "numpy_extension", "contrib", "amp", "module",
               "monitor", "checkpoint", "dmlc_params", "operator",
               "pipeline", "name", "attribute", "rtc", "native",
-              "visualization", "library", "telemetry"}
+              "visualization", "library", "telemetry", "resilience"}
     if name in lazies:
         mod = _lazy(name)
         globals()[name] = mod

@@ -16,15 +16,29 @@ byte-level save_states/load_states contract.
 Interchange with the reference stays on ``.params`` files
 (``mx.nd.save(..., format='dmlc')`` — dmlc_params.py); this module is the
 fast in-training path.
+
+Preemption safety (ISSUE 3): a step only becomes visible once it is
+recorded in ``manifest.json``, which is committed with an atomic
+write-then-rename AFTER the data is fully on disk — a save killed midway
+leaves no half-written step for ``latest_step``/``restore`` to pick up.
+``restore(step=None)`` detects a corrupted latest step and falls back to
+the previous good one; ``auto_resume`` installs a SIGTERM hook
+(checkpoint after the in-flight step, then stop cleanly) and a restart
+policy that replays from the last good step when ``train_fn`` faults
+mid-run.  Chaos site: ``checkpoint.save`` (fires between data write and
+manifest commit — the window atomicity must cover).
 """
 
 from __future__ import annotations
 
+import json
 import os
 
 from .base import MXNetError
 from . import config
+from . import resilience as _res
 from . import telemetry as _tel
+from .resilience import chaos as _chaos
 
 __all__ = ["CheckpointManager", "auto_resume"]
 
@@ -32,6 +46,10 @@ _M_SAVE_SECONDS = _tel.histogram(
     "mxnet_checkpoint_save_seconds", "Checkpoint save latency (blocking).")
 _M_RESTORE_SECONDS = _tel.histogram(
     "mxnet_checkpoint_restore_seconds", "Checkpoint restore latency.")
+_M_CORRUPT = _tel.counter(
+    "mxnet_checkpoint_corrupt_steps_total",
+    "Checkpoint steps that failed to restore and were skipped by the "
+    "fall-back-to-previous policy.")
 
 
 def _ocp():
@@ -51,9 +69,11 @@ class CheckpointManager:
         self._dir = os.path.abspath(directory)
         keep = max_to_keep if max_to_keep is not None \
             else config.get_int("MXNET_CHECKPOINT_KEEP", 3)
+        self._keep = keep
         self._mgr = ocp.CheckpointManager(
             self._dir, options=ocp.CheckpointManagerOptions(
                 max_to_keep=keep, create=True))
+        self._manifest_path = os.path.join(self._dir, "manifest.json")
 
     # -- helpers ------------------------------------------------------------
     @staticmethod
@@ -61,8 +81,45 @@ class CheckpointManager:
         return {name: p.data()._data
                 for name, p in net.collect_params().items()}
 
+    # -- commit manifest (atomicity layer) ----------------------------------
+    def _read_manifest(self):
+        """Committed step list, or None when absent/unreadable (pre-manifest
+        directories fall back to the backend's view)."""
+        try:
+            with open(self._manifest_path) as f:
+                data = json.load(f)
+        except (FileNotFoundError, ValueError, OSError):
+            return None
+        steps = data.get("committed")
+        if not isinstance(steps, list):
+            return None
+        return sorted(int(s) for s in steps)
+
+    def _write_manifest(self, committed):
+        """Atomic write-then-rename (satellite: non-atomic checkpoint
+        writes): a kill at ANY point leaves either the old manifest or the
+        new one, never a half-written file."""
+        tmp = f"{self._manifest_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"committed": sorted(int(s) for s in committed)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._manifest_path)
+
+    def committed_steps(self):
+        """Steps that finished their save AND their manifest commit,
+        oldest first.  An uncommitted step directory (killed save) is
+        invisible here even if the backend wrote it fully."""
+        present = sorted(self._mgr.all_steps())
+        manifest = self._read_manifest()
+        if manifest is None:
+            return present
+        on_disk = set(present)
+        return [s for s in manifest if s in on_disk]
+
     def latest_step(self):
-        return self._mgr.latest_step()
+        steps = self.committed_steps()
+        return steps[-1] if steps else None
 
     def all_steps(self):
         return sorted(self._mgr.all_steps())
@@ -88,10 +145,38 @@ class CheckpointManager:
             tree["trainer_states"] = np.frombuffer(blob, dtype=np.uint8)
         if not tree:
             raise MXNetError("nothing to checkpoint: pass net/trainer/extra")
+        step = int(step)
+        # snapshot the directory listing and manifest ONCE (each
+        # all_steps() is a checkpoint-dir listing — a network round-trip
+        # on cloud storage; save_every=1 pays this per training step)
+        on_disk = set(self._mgr.all_steps())
+        manifest = self._read_manifest()
+        committed = set(s for s in manifest if s in on_disk) \
+            if manifest is not None else set(on_disk)
+        if step in on_disk and step not in committed:
+            # orphaned step directory from a save killed before its
+            # manifest commit: clear it so the replayed save can land
+            self._mgr.delete(step)
         with _tel.span("checkpoint.save", "checkpoint", step=step) as sp:
             saved = self._mgr.save(step, args=ocp.args.StandardSave(tree),
                                    force=force)
             self._mgr.wait_until_finished()
+            if _chaos._ACTIVE:
+                # the chaos site sits in the atomicity-critical window:
+                # data fully written, manifest not yet committed — a fault
+                # here must leave the step invisible to latest_step()
+                _chaos.hit("checkpoint.save", step=step)
+            if saved:
+                committed.add(step)
+                # predict the backend's max_to_keep pruning (newest kept)
+                # from the pre-save snapshot instead of re-listing the
+                # directory; committed_steps() re-intersects with the real
+                # listing on read, so a prediction miss only hides a
+                # beyond-keep step, never resurrects a pruned one
+                if self._keep:
+                    retained = sorted(on_disk | {step})[-self._keep:]
+                    committed &= set(retained)
+                self._write_manifest(committed)
         if sp is not _tel.NULL_SPAN:
             _M_SAVE_SECONDS.observe(sp.duration_s)
         return bool(saved)
@@ -100,12 +185,33 @@ class CheckpointManager:
         """Restore ``step`` (default latest) into net/trainer in place.
 
         Returns (step, extra_dict) or (None, {}) when no checkpoint exists.
+        With ``step=None`` a corrupted step is skipped with a warning and
+        the previous committed step restores instead (elastic-resume
+        contract); an explicitly requested step propagates its error.
         """
-        ocp = _ocp()
-        if step is None:
-            step = self._mgr.latest_step()
-        if step is None:
+        if step is not None:
+            return self._restore_step(step, net=net, trainer=trainer)
+        candidates = list(reversed(self.committed_steps()))
+        if not candidates:
             return None, {}
+        last_exc = None
+        for s in candidates:
+            try:
+                return self._restore_step(s, net=net, trainer=trainer)
+            except Exception as exc:  # noqa: BLE001 — corruption fallback
+                import warnings
+                last_exc = exc
+                _M_CORRUPT.inc()
+                _tel.instant("checkpoint.corrupt", "resilience", step=s)
+                warnings.warn(
+                    f"checkpoint step {s} failed to restore ({exc!r}); "
+                    "falling back to the previous step", stacklevel=2)
+        raise MXNetError(
+            f"no restorable checkpoint in {self._dir}: every committed "
+            f"step {list(reversed(candidates))} failed") from last_exc
+
+    def _restore_step(self, step, net=None, trainer=None):
+        ocp = _ocp()
         with _tel.span("checkpoint.restore", "checkpoint", step=step) as sp:
             tree = self._mgr.restore(step, args=ocp.args.StandardRestore())
             if net is not None:
@@ -148,8 +254,45 @@ def _as_nd(arr):
     return NDArray._from_data(jnp.asarray(arr))
 
 
+class _SigtermHook:
+    """Flag-only SIGTERM handler: preemption notices (SIGTERM is what TPU
+    preemption and k8s eviction deliver) set a flag the training loop
+    checks BETWEEN steps, so the emergency save always captures a
+    consistent post-step state — never a mid-update one."""
+
+    def __init__(self):
+        self.fired = False
+        self._prev = None
+        self._installed = False
+
+    def _handler(self, signum, frame):  # noqa: ARG002
+        self.fired = True
+
+    def install(self):
+        import signal
+        import threading
+        if threading.current_thread() is not threading.main_thread():
+            return self  # signal.signal is main-thread-only; stay passive
+        try:
+            self._prev = signal.signal(signal.SIGTERM, self._handler)
+            self._installed = True
+        except ValueError:
+            pass
+        return self
+
+    def uninstall(self):
+        if self._installed:
+            import signal
+            # signal.signal() returns None when the previous handler was
+            # installed from C; None is not restorable — use the default
+            prev = self._prev if self._prev is not None else signal.SIG_DFL
+            signal.signal(signal.SIGTERM, prev)
+            self._installed = False
+
+
 def auto_resume(train_fn, directory, net=None, trainer=None,
-                save_every=1, max_to_keep=None):
+                save_every=1, max_to_keep=None, resume_policy="restart",
+                max_restarts=3, sigterm_save=None):
     """First-class resume loop (SURVEY §5.3 'build the auto-resume loop').
 
     ``train_fn(step) -> bool`` runs ONE step at global step ``step`` and
@@ -157,14 +300,76 @@ def auto_resume(train_fn, directory, net=None, trainer=None,
     restored into ``net``/``trainer`` and stepping continues AFTER it — a
     restarted job (preemption, TPU fault) reproduces the unkilled loss
     curve.  Returns the last completed step.
+
+    Resilience (ISSUE 3):
+
+    - ``resume_policy="restart"`` (default): when ``train_fn`` raises,
+      restore the last good checkpoint into ``net``/``trainer`` and replay
+      from the step after it, up to ``max_restarts`` times (counted in
+      ``mxnet_resilience_resumes_total``).  A fault before the first
+      checkpoint exists re-raises — there is no good state to replay from.
+      ``resume_policy="none"`` re-raises immediately.
+    - SIGTERM (preemption notice): when ``sigterm_save`` (default
+      ``MXNET_RESILIENCE_SIGTERM_SAVE=1``) is on, a SIGTERM checkpoints
+      after the in-flight step completes and returns cleanly; the next
+      ``auto_resume`` continues exactly there.
     """
+    import warnings
     mgr = CheckpointManager(directory, max_to_keep=max_to_keep)
     last, _ = mgr.restore(net=net, trainer=trainer)
+    if last is not None:
+        _res.record_resume()
     step = (last + 1) if last is not None else 0
-    while True:
-        more = train_fn(step)
-        if step % save_every == 0 or not more:
-            mgr.save(step, net=net, trainer=trainer)
-        if not more:
-            return step
-        step += 1
+    restarts = 0
+    if sigterm_save is None:
+        sigterm_save = bool(config.get_int("MXNET_RESILIENCE_SIGTERM_SAVE", 1))
+    hook = _SigtermHook().install() if sigterm_save else None
+    try:
+        while True:
+            try:
+                more = train_fn(step)
+            except Exception as exc:  # noqa: BLE001 — elastic restart
+                if hook is not None and hook.fired:
+                    # preemption arrived while the step was failing (e.g.
+                    # peers already exited and the collective timed out):
+                    # replaying would wedge until SIGKILL — stop cleanly
+                    # at the last checkpointed step instead
+                    last_good = mgr.latest_step()
+                    if last_good is None:
+                        raise
+                    warnings.warn(
+                        f"SIGTERM received and step {step} failed "
+                        f"({exc!r}); stopping at checkpointed step "
+                        f"{last_good} without replay", stacklevel=2)
+                    return last_good
+                if resume_policy != "restart" or restarts >= max_restarts:
+                    raise
+                good, _ = mgr.restore(net=net, trainer=trainer)
+                if good is None:
+                    raise  # faulted before the first checkpoint
+                restarts += 1
+                _res.record_resume()
+                _tel.instant("auto_resume.restart", "resilience",
+                             failed_step=step, resume_from=good)
+                warnings.warn(
+                    f"train_fn failed at step {step} ({exc!r}); resumed "
+                    f"from checkpoint step {good} "
+                    f"(restart {restarts}/{max_restarts})", stacklevel=2)
+                step = good + 1
+                continue
+            preempted = hook is not None and hook.fired
+            if step % save_every == 0 or not more or preempted:
+                mgr.save(step, net=net, trainer=trainer, force=preempted)
+            if preempted:
+                _tel.instant("auto_resume.preempted", "resilience",
+                             step=step)
+                warnings.warn(
+                    f"SIGTERM received: emergency checkpoint at step "
+                    f"{step}; stopping cleanly", stacklevel=2)
+                return step
+            if not more:
+                return step
+            step += 1
+    finally:
+        if hook is not None:
+            hook.uninstall()

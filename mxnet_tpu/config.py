@@ -92,6 +92,40 @@ KNOWN_VARS = {
     "MXNET_CHECKPOINT_KEEP": (
         "3", int,
         "How many step checkpoints mx.checkpoint.CheckpointManager retains."),
+    # resilience family (ISSUE 3: mx.resilience)
+    "MXNET_KVSTORE_TIMEOUT_S": (
+        "300", float,
+        "Deadline (seconds) on blocking dist-kvstore calls (bring-up, "
+        "allreduce, barrier): a dead/wedged peer raises KVStoreTimeoutError "
+        "instead of hanging forever. 0 disables the bound."),
+    "MXNET_RESILIENCE_MAX_RETRIES": (
+        "3", int,
+        "Re-attempts a Retry policy makes after the first failure of a "
+        "transient (retryable) operation; 0 fails fast."),
+    "MXNET_RESILIENCE_BACKOFF_S": (
+        "0.05", float,
+        "Base backoff (seconds) before retry attempt k sleeps "
+        "backoff * 2^k (with +/-25% jitter)."),
+    "MXNET_RESILIENCE_BACKOFF_MAX_S": (
+        "2", float, "Cap on the exponential retry backoff (seconds)."),
+    "MXNET_RESILIENCE_SIGTERM_SAVE": (
+        "1", int,
+        "If 1, mx.checkpoint.auto_resume installs a SIGTERM hook that "
+        "checkpoints after the in-flight step and exits cleanly "
+        "(preemption-safe save); 0 leaves the default signal behavior."),
+    "MXNET_DATALOADER_RETRIES": (
+        "2", int,
+        "Worker-pool batch failures DataLoader absorbs via in-process "
+        "refetch before permanently degrading to single-process loading."),
+    "MXNET_CHAOS": (
+        "0", int,
+        "If 1, arm chaos faults from MXNET_CHAOS_SITES at import "
+        "(mx.resilience.chaos fault injection for recovery testing)."),
+    "MXNET_CHAOS_SITES": (
+        None, str,
+        "Comma list of faults to arm when MXNET_CHAOS=1: "
+        "'site:kind[:times[:delay_s]]' with kind in "
+        "delay|transient|fatal|exit, e.g. 'kvstore.allreduce:transient:2'."),
 }
 
 _lock = threading.Lock()

@@ -16,9 +16,12 @@ import multiprocessing as mp
 
 import numpy as _np
 
+from ... import config
 from ... import ndarray as nd
+from ... import resilience as _res
 from ... import telemetry as _tel
 from ...ndarray.ndarray import NDArray
+from ...resilience import chaos as _chaos
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
 __all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
@@ -93,59 +96,108 @@ class DataLoader:
         self._num_workers = max(0, num_workers)
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        # bounded pool-failure budget before degrading to single-process
+        # loading (ISSUE 3 graceful degradation)
+        self._max_pool_failures = config.get_int("MXNET_DATALOADER_RETRIES", 2)
         self._pool = None
         if self._num_workers > 0:
             self._pool = mp.get_context("fork").Pool(
                 self._num_workers, initializer=_worker_init,
                 initargs=(dataset,))
 
+    def _materialize(self, batch_idx):
+        """In-process fetch + batchify of one batch (the synchronous path
+        and the pool-failure fallback; chaos site ``dataloader.fetch``)."""
+        with _tel.span("dataloader.batch", "data",
+                       samples=len(batch_idx)) as sp:
+            if _chaos._ACTIVE:
+                _chaos.hit("dataloader.fetch")
+            batch = self._batchify_fn(
+                [self._dataset[i] for i in batch_idx])
+        if sp is not _tel.NULL_SPAN:
+            _M_BATCHES.inc()
+            _M_BATCH_SECONDS.observe(sp.duration_s)
+        return batch
+
     def __iter__(self):
         if self._pool is None:
             for batch_idx in self._batch_sampler:
-                with _tel.span("dataloader.batch", "data",
-                               samples=len(batch_idx)) as sp:
-                    batch = self._batchify_fn(
-                        [self._dataset[i] for i in batch_idx])
-                if sp is not _tel.NULL_SPAN:
-                    _M_BATCHES.inc()
-                    _M_BATCH_SECONDS.observe(sp.duration_s)
-                yield batch
+                yield self._materialize(batch_idx)
             return
-        # async pool path with bounded prefetch
-        results = []
+        yield from self._iter_pool()
+
+    def _iter_pool(self):
+        """Async pool path with bounded prefetch.  A crashed or hung
+        worker must not hang training: each ``get`` is bounded by
+        ``timeout``, a failed batch is refetched in-process (the dataset
+        lives in this process too), and after MXNET_DATALOADER_RETRIES
+        failures the pool is abandoned for single-process loading."""
+        import warnings
+        results = []  # (batch_idx, AsyncResult)
         it = iter(self._batch_sampler)
+        failures = 0
 
         def issue():
             try:
                 idx = next(it)
             except StopIteration:
                 return False
-            results.append(self._pool.apply_async(_worker_fn, (idx,)))
+            results.append((idx, self._pool.apply_async(_worker_fn, (idx,))))
             return True
 
         for _ in range(self._prefetch):
             if not issue():
                 break
         while results:
-            r = results.pop(0)
+            idx, r = results.pop(0)
             issue()
             if _tel.enabled():
                 _M_QUEUE_DEPTH.set(len(results))
             with _tel.span("dataloader.batch", "data",
                            queue_depth=len(results)) as sp:
-                batch = r.get(self._timeout)
-                if isinstance(batch, tuple):
-                    out = tuple(nd.array(b) for b in batch)
-                else:
-                    out = nd.array(batch)
+                try:
+                    if _chaos._ACTIVE:
+                        _chaos.hit("dataloader.fetch")
+                    batch = r.get(self._timeout)
+                    if isinstance(batch, tuple):
+                        out = tuple(nd.array(b) for b in batch)
+                    else:
+                        out = nd.array(batch)
+                except Exception as exc:  # noqa: BLE001 — degrade, don't hang
+                    failures += 1
+                    _res.record_fallback()
+                    warnings.warn(
+                        f"DataLoader worker batch failed ({exc!r}); "
+                        "refetched in-process", stacklevel=2)
+                    out = self._batchify_fn(
+                        [self._dataset[i] for i in idx])
             if sp is not _tel.NULL_SPAN:
                 _M_BATCHES.inc()
                 _M_BATCH_SECONDS.observe(sp.duration_s)
             yield out
+            if failures and failures >= self._max_pool_failures \
+                    and self._pool is not None:
+                # the pool is unreliable — degrade permanently to
+                # single-process loading for the rest of this loader's life
+                warnings.warn(
+                    f"DataLoader worker pool failed {failures} times; "
+                    "degrading to single-process loading", stacklevel=2)
+                pending = [i for i, _ in results]
+                results.clear()
+                self._shutdown_pool()
+                for batch_idx in pending:
+                    yield self._materialize(batch_idx)
+                for batch_idx in it:
+                    yield self._materialize(batch_idx)
+                return
+
+    def _shutdown_pool(self):
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.terminate()
 
     def __len__(self):
         return len(self._batch_sampler)
 
     def __del__(self):
-        if self._pool is not None:
-            self._pool.terminate()
+        self._shutdown_pool()

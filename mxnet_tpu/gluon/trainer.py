@@ -31,6 +31,7 @@ from __future__ import annotations
 from ..base import MXNetError
 from .. import optimizer as opt
 from .. import telemetry as _tel
+from ..resilience import chaos as _chaos
 from .parameter import ParameterDict, Parameter
 
 __all__ = ["Trainer"]
@@ -114,6 +115,11 @@ class Trainer:
         else:
             self._kvstore = kvt
         if self._kvstore is not None:
+            if hasattr(self._kvstore, "_ensure_dist"):
+                # surface distributed bring-up failures HERE, deadline-
+                # bounded with a clear KVStoreTimeoutError (ISSUE 3),
+                # instead of hanging inside the first step's collective
+                self._kvstore._ensure_dist()
             if self._compression_params:
                 self._kvstore.set_gradient_compression(
                     self._compression_params)
@@ -146,6 +152,8 @@ class Trainer:
         scaler backs off (reference amp trainer flow).
         """
         self._init_kvstore()
+        if _chaos._ACTIVE:
+            _chaos.hit("trainer.step")  # named chaos site (mid-run faults)
         with _tel.span("trainer.step", "trainer", batch_size=batch_size) as sp:
             scaler = getattr(self, "_amp_loss_scaler", None)
             base_scale = getattr(self, "_amp_original_scale", self._scale)

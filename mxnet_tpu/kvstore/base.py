@@ -57,6 +57,16 @@ class KVStoreBase:
     def broadcast(self, key, value, out):
         raise NotImplementedError
 
+    def barrier(self):
+        """Synchronize all workers.  Default: delegate to the internal
+        ``_barrier`` when the backend has one (local stores wait for
+        outstanding async work; the dist store runs a deadline-bounded
+        collective sync that raises KVStoreTimeoutError — never hangs —
+        when a peer is missing), else no-op for single-worker backends."""
+        inner = getattr(self, "_barrier", None)
+        if inner is not None:
+            inner()
+
     def pushpull(self, key, value, out=None, priority=0):
         raise NotImplementedError
 

@@ -28,6 +28,8 @@ from ..base import MXNetError
 from .. import config
 from .. import ndarray as nd
 from .. import telemetry as _tel
+from ..resilience import Deadline, KVStoreTimeoutError, Retry
+from ..resilience import chaos as _chaos
 from .local import KVStoreLocal
 
 # registry get-or-create: same handles local.py registered
@@ -63,6 +65,11 @@ class KVStoreDistTPUSync(KVStoreLocal):
         self._mesh = None
         self._psum_cache = {}
         self._sparse_ps = None  # host KV service, created on first sparse key
+        # resilience policies (ISSUE 3): every blocking cross-process call
+        # is deadline-bounded (a dead peer raises KVStoreTimeoutError
+        # instead of hanging) and transient failures retry with backoff
+        self._retry = Retry(site="kvstore.allreduce")
+        self._deadline = Deadline(site="kvstore.allreduce")
 
     def _ps(self):
         if self._sparse_ps is None:
@@ -144,14 +151,49 @@ class KVStoreDistTPUSync(KVStoreLocal):
         coord = os.environ.get("MXNET_DIST_COORDINATOR") \
             or os.environ.get("JAX_COORDINATOR_ADDRESS")
         if coord and jax.process_count() == 1:
+            nproc = int(os.environ.get("MXNET_DIST_NUM_WORKERS", "1"))
+            rank = int(os.environ.get("MXNET_DIST_RANK", "0"))
+            kwargs = dict(coordinator_address=coord, num_processes=nproc,
+                          process_id=rank)
+            t = self._deadline.timeout_s
+            if t and t > 0:
+                # bound the rendezvous itself: a missing peer must error,
+                # not hang the bring-up forever
+                kwargs["initialization_timeout"] = max(1, int(t))
             try:
-                jax.distributed.initialize(
-                    coordinator_address=coord,
-                    num_processes=int(os.environ.get("MXNET_DIST_NUM_WORKERS",
-                                                     "1")),
-                    process_id=int(os.environ.get("MXNET_DIST_RANK", "0")))
-            except RuntimeError:
-                pass  # already initialized by the launcher
+                try:
+                    jax.distributed.initialize(**kwargs)
+                except TypeError:  # older jax without initialization_timeout
+                    kwargs.pop("initialization_timeout", None)
+                    jax.distributed.initialize(**kwargs)
+            except RuntimeError as e:
+                msg = str(e).lower()
+                if "already" in msg or "only be called once" in msg \
+                        or "must be called before" in msg:
+                    # benign re-initialize (jax phrases this as "should
+                    # only be called once" / "must be called before any
+                    # JAX computations", not "already") — but verify the
+                    # world actually formed below: for a multi-worker job
+                    # this same error can mean bring-up FAILED because the
+                    # backend was touched first, and proceeding would
+                    # silently train unsynchronized
+                    pass
+                elif "timed out" in msg or "timeout" in msg \
+                        or "deadline" in msg:
+                    raise KVStoreTimeoutError(
+                        f"distributed bring-up: rank {rank} could not "
+                        f"rendezvous with all {nproc} workers at {coord} "
+                        f"within {t:g}s (MXNET_KVSTORE_TIMEOUT_S) — a peer "
+                        "never arrived") from e
+                else:
+                    raise
+            if nproc > 1 and jax.process_count() == 1:
+                raise MXNetError(
+                    f"distributed bring-up: MXNET_DIST_NUM_WORKERS={nproc} "
+                    "but the process group never formed (the jax backend "
+                    "was initialized before the dist kvstore). Create the "
+                    "kvstore — or call jax.distributed.initialize — before "
+                    "any array/computation touches the backend.")
         self._initialized = True
 
     @property
@@ -211,18 +253,47 @@ class KVStoreDistTPUSync(KVStoreLocal):
         lowers the psum to reduce-scatter + all-gather on large inputs, so
         MXNET_KVSTORE_BIGARRAY_BOUND remains an env knob for parity but no
         longer selects a different code path.
+
+        Resilience: the collective is deadline-bounded (a dead peer raises
+        KVStoreTimeoutError instead of wedging) and transient failures in
+        the PRE-dispatch region retry with backoff.  Once multi-process,
+        neither timeouts nor post-dispatch transients are retried —
+        re-entering a collective that peers already ran (or never joined)
+        would desynchronize the global collective order.  In-process the
+        whole attempt retries (no peers to desync).
         """
         import jax
         if jax.process_count() <= 1:
+            return self._retry.call(self._allreduce_attempt, arr)
+        self._retry.call(self._chaos_gate)
+        return self._allreduce_collective(arr)
+
+    @staticmethod
+    def _chaos_gate():
+        if _chaos._ACTIVE:
+            _chaos.hit("kvstore.allreduce")
+
+    def _allreduce_attempt(self, arr):
+        self._chaos_gate()
+        import jax
+        if jax.process_count() <= 1:
             return arr
+        return self._allreduce_collective(arr)
+
+    def _allreduce_collective(self, arr):
+        import jax
         import jax.numpy as jnp
         with _tel.span("kvstore.allreduce", "kvstore") as span_:
             if span_ is not _tel.NULL_SPAN:
                 span_.set(bytes=int(arr.nbytes))
-            garr = self._make_global(arr)
-            out = self._psum_fn(arr.shape, arr.dtype)(garr)
-            # fully replicated output: this process reads its local copy
-            res = jnp.asarray(out.addressable_data(0))
+
+            def collective():
+                garr = self._make_global(arr)
+                out = self._psum_fn(arr.shape, arr.dtype)(garr)
+                # fully replicated output: this process reads its local copy
+                return jnp.asarray(out.addressable_data(0))
+
+            res = self._deadline.call(collective)
         if span_ is not _tel.NULL_SPAN:
             _M_ALLREDUCE_SECONDS.observe(span_.duration_s)
             _M_ALLREDUCE_BYTES.inc(int(arr.nbytes))
@@ -339,12 +410,21 @@ class KVStoreDistTPUSync(KVStoreLocal):
 
     def _barrier(self):
         self._ensure_dist()
+        if _chaos._ACTIVE:
+            _chaos.hit("dist.barrier")
         import jax
         if jax.process_count() > 1:
-            # all-processes sync point: a tiny global psum
+            # all-processes sync point: a tiny global psum, deadline-bounded
+            # through _allreduce so a dead peer raises instead of hanging
             import jax.numpy as jnp
-            jax.block_until_ready(self._allreduce(jnp.zeros((1,))))
+            try:
+                jax.block_until_ready(self._allreduce(jnp.zeros((1,))))
+            except KVStoreTimeoutError as e:
+                rank, n = jax.process_index(), jax.process_count()
+                missing = sorted(set(range(n)) - {rank})
+                raise KVStoreTimeoutError(
+                    f"dist.barrier: rank {rank} reached the barrier but at "
+                    f"least one of ranks {missing} (world size {n}) never "
+                    f"arrived within {self._deadline.timeout_s:g}s "
+                    "(MXNET_KVSTORE_TIMEOUT_S)") from e
         nd.waitall()
-
-    def barrier(self):
-        self._barrier()

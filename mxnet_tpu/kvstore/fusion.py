@@ -68,6 +68,11 @@ _M_FALLBACK_KEYS = _tel.counter(
 _M_BUCKET_SECONDS = _tel.histogram(
     "mxnet_kvstore_fused_bucket_seconds",
     "Host-side latency per fused bucket (flatten+reduce+scatter dispatch).")
+_M_BUCKET_ERRORS = _tel.counter(
+    "mxnet_kvstore_fused_bucket_errors_total",
+    "Fused buckets whose executable FAILED and were replayed through the "
+    "per-key path (ISSUE 3 graceful degradation — distinct from the "
+    "planned fallback rules above, which never enter a bucket).")
 
 
 def tree_sum(arrays):
@@ -264,3 +269,10 @@ def record_pushpull():
 def record_fallback(n_keys):
     if n_keys:
         _M_FALLBACK_KEYS.inc(n_keys)
+
+
+def record_bucket_error(n_keys):
+    """One fused bucket errored at execution time and degraded per-key
+    (unconditional: failures are rare and must never be invisible)."""
+    _M_BUCKET_ERRORS.inc()
+    _M_FALLBACK_KEYS.inc(n_keys)

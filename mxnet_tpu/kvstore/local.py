@@ -249,27 +249,44 @@ class KVStoreLocal(KVStoreBase):
             total_bytes = 0
             for b in buckets:
                 t0 = _time.perf_counter_ns() if enabled else 0
-                prim_ctx = vlists[b.positions[0]][0].ctx
-                prim_dev = None  # resolved lazily; staging is the rare case
-                arrays = []
-                for r in range(b.n_rep):
-                    for p in b.positions:
-                        v = vlists[p][r]
-                        a = v._data
-                        if v.ctx != prim_ctx:
-                            if prim_dev is None:
-                                prim_dev = prim_ctx.jax_device()
-                            a = jax.device_put(a, prim_dev)
-                        arrays.append(a)
-                if needs_flat:
-                    # wire strategy: one flat buffer → ONE collective/bucket
-                    flat = bucketer.reduce_flat(b, arrays)
-                    flat = self._allreduce_flat(flat)
-                    parts = bucketer.unflatten(b, flat)
-                elif b.n_rep == 1:
-                    parts = arrays  # identity reduction: zero device work
-                else:
-                    parts = bucketer.reduce_bucket(b, arrays)
+                try:
+                    prim_ctx = vlists[b.positions[0]][0].ctx
+                    prim_dev = None  # resolved lazily; staging is rare
+                    arrays = []
+                    for r in range(b.n_rep):
+                        for p in b.positions:
+                            v = vlists[p][r]
+                            a = v._data
+                            if v.ctx != prim_ctx:
+                                if prim_dev is None:
+                                    prim_dev = prim_ctx.jax_device()
+                                a = jax.device_put(a, prim_dev)
+                            arrays.append(a)
+                    if needs_flat:
+                        # wire strategy: one flat buffer → ONE collective
+                        flat = bucketer.reduce_flat(b, arrays)
+                        flat = self._allreduce_flat(flat)
+                        parts = bucketer.unflatten(b, flat)
+                    elif b.n_rep == 1:
+                        parts = arrays  # identity reduction: no device work
+                    else:
+                        parts = bucketer.reduce_bucket(b, arrays)
+                except Exception as exc:
+                    from ..resilience import ResilienceError
+                    if isinstance(exc, ResilienceError) or needs_flat:
+                        # cluster-level failures (timeouts, exhausted
+                        # retries, injected deaths) — and ANY rank-local
+                        # failure in multi-process mode — must propagate:
+                        # replaying per-key here while peers ran the fused
+                        # collective would desynchronize the global
+                        # collective order
+                        raise
+                    # graceful degradation (ISSUE 3), in-process only: a
+                    # failing fused bucket executable must not take the
+                    # step down — the pushed values are untouched, so
+                    # replaying its keys per-key recomputes the same result
+                    self._fused_bucket_fallback(b, keys, vlists, outs)
+                    continue
                 for p, arr in zip(b.positions, parts):
                     self._store[keys[p]]._set_data(arr)
                     o = outs[p]
@@ -288,6 +305,23 @@ class KVStoreLocal(KVStoreBase):
                 fusion.record_pushpull()
                 span_.set(keys=len(keys), buckets=len(buckets),
                           bytes=total_bytes)
+
+    def _fused_bucket_fallback(self, bucket, keys, vlists, outs):
+        """Replay one failed fused bucket through the per-key path
+        (graceful degradation; counted in
+        mxnet_resilience_fallbacks_total + the fused fallback counter)."""
+        import warnings
+        from .. import resilience as _res
+        # shared counter counts degradation EVENTS (one per bucket);
+        # per-key accounting rides the fused fallback-keys counter
+        _res.record_fallback()
+        fusion.record_bucket_error(len(bucket.positions))
+        warnings.warn(
+            f"fused pushpull bucket of {len(bucket.positions)} keys failed; "
+            "falling back to per-key pushpull", stacklevel=3)
+        for p in bucket.positions:
+            v = vlists[p]
+            self.pushpull(keys[p], v if len(v) > 1 else v[0], out=outs[p])
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)

@@ -1,0 +1,64 @@
+"""mx.resilience — fault injection, retry/deadline policies, and
+preemption-safe recovery (ISSUE 3 tentpole).
+
+The reference assumes long multi-host runs where workers die and
+preemption is routine, but ships no way to bound, recover from, or even
+*test* those failures (SURVEY §5.3).  This subsystem is that layer for
+the TPU rebuild, wired into the same chokepoints telemetry instruments:
+
+- ``policies`` — composable ``Retry`` (exponential backoff + jitter) and
+  ``Deadline`` (per-call timeout → ``KVStoreTimeoutError``) applied to
+  dist-kvstore init/push/pull/pushpull_list/barrier and process-group
+  bring-up.
+- ``chaos`` — deterministic fault injection (delays, transient errors,
+  worker death) at named sites, env- and API-driven, so every recovery
+  path runs on CPU in CI.
+- elastic resume — ``mx.checkpoint`` gained an atomic commit manifest,
+  corruption fallback, SIGTERM-triggered emergency save, and an
+  ``auto_resume`` restart policy that replays from the last good step.
+- graceful degradation — DataLoader worker crashes fall back to
+  in-process fetch; fused kvstore bucket failures fall back per-key.
+
+Every recovery event flows through mx.telemetry:
+``mxnet_resilience_{retries,faults_injected,deadline_exceeded,resumes,
+fallbacks}_total`` plus the ``mxnet_resilience_retry_backoff_seconds``
+histogram.  Nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+from .. import telemetry as _tel
+from . import chaos, policies  # noqa: F401
+from .policies import (  # noqa: F401
+    Deadline, KVStoreTimeoutError, ResilienceError, Retry,
+    RetryExhaustedError, TransientError, is_transient, protect,
+)
+from .chaos import (  # noqa: F401
+    ChaosError, ChaosTransientError, ChaosWorkerDeath,
+)
+
+__all__ = [
+    "Retry", "Deadline", "protect", "is_transient",
+    "ResilienceError", "TransientError", "RetryExhaustedError",
+    "KVStoreTimeoutError",
+    "ChaosError", "ChaosTransientError", "ChaosWorkerDeath",
+    "chaos", "policies", "record_fallback", "record_resume",
+]
+
+# shared recovery counters (the per-policy ones live in policies.py)
+_M_RESUMES = _tel.counter(
+    "mxnet_resilience_resumes_total",
+    "Elastic resumes: auto_resume restoring state from a checkpoint "
+    "(at entry and after an in-run fault).")
+_M_FALLBACKS = _tel.counter(
+    "mxnet_resilience_fallbacks_total",
+    "Graceful degradation EVENTS (one per occurrence): a dataloader batch "
+    "refetched in-process, or a fused kvstore bucket replayed per-key.")
+
+
+def record_fallback(n=1):
+    _M_FALLBACKS.inc(n)
+
+
+def record_resume(n=1):
+    _M_RESUMES.inc(n)

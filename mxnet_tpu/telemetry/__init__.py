@@ -13,7 +13,10 @@ Chrome-trace writer + aggregate_stats.cc per-op table):
 
 Instrumentation ships wired into the runtime chokepoints: op dispatch
 (ops.registry), kvstore push/pull/allreduce, gluon.Trainer step phases,
-DataLoader batch fetch, and checkpoint save/load.  Everything is gated on
+DataLoader batch fetch, and checkpoint save/load.  The resilience layer
+(mx.resilience, ISSUE 3) reports through the same registry:
+``mxnet_resilience_{retries,faults_injected,deadline_exceeded,resumes,
+fallbacks}_total`` and ``mxnet_resilience_retry_backoff_seconds``.  Everything is gated on
 one flag: ``MXNET_TELEMETRY=1`` in the environment, ``telemetry.enable()``
 at runtime, or implicitly via ``mx.profiler.start()``.  When the flag is
 off, the dispatch hot path pays exactly one module-attribute check and the

@@ -189,3 +189,71 @@ def test_kill_and_resume_reproduces_loss_curve(tmp_path):
     curve = losses_a + losses_b
     assert len(curve) == total
     np.testing.assert_allclose(curve, ref, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# edge cases (ISSUE 3 satellites): pruning order, corruption fallback,
+# trainer-state round-trip
+# ---------------------------------------------------------------------------
+
+def test_max_to_keep_prunes_oldest_first(tmp_path):
+    mgr = mx.checkpoint.CheckpointManager(str(tmp_path / "keep"),
+                                          max_to_keep=2)
+    for s in range(5):
+        mgr.save(s, extra={"v": mx.nd.array([float(s)])})
+    # oldest steps pruned, newest retained, in order
+    assert mgr.all_steps() == [3, 4]
+    assert mgr.committed_steps() == [3, 4]
+    assert mgr.latest_step() == 4
+    # the manifest never references pruned steps
+    step, extra = mgr.restore()
+    assert step == 4 and float(extra["v"].asnumpy()[0]) == 4.0
+
+
+def test_restore_falls_back_past_corrupted_latest(tmp_path):
+    import glob
+    import os as _os
+    d = str(tmp_path / "corrupt")
+    mgr = mx.checkpoint.CheckpointManager(d, max_to_keep=4)
+    mgr.save(0, extra={"v": mx.nd.array([10.0])})
+    mgr.save(1, extra={"v": mx.nd.array([11.0])})
+    # trash every data file of the latest step
+    for f in glob.glob(_os.path.join(d, "1", "**", "*"), recursive=True):
+        if _os.path.isfile(f):
+            with open(f, "wb") as fh:
+                fh.write(b"garbage")
+    with pytest.warns(UserWarning, match="falling back"):
+        step, extra = mgr.restore()
+    assert step == 0
+    assert float(extra["v"].asnumpy()[0]) == 10.0
+    # an EXPLICITLY requested corrupted step still errors
+    with pytest.raises(Exception):
+        mgr.restore(step=1)
+
+
+def test_trainer_state_roundtrip_equality(tmp_path):
+    import tempfile
+    from mxnet_tpu import autograd, gluon  # noqa: F811
+
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    r = np.random.RandomState(5)
+    X = mx.nd.array(r.randn(8, 6).astype(np.float32))
+    Y = mx.nd.array(r.randint(0, 4, (8,)))
+    net, tr = _make_net_trainer()
+    _step(net, tr, X, Y, lossf)  # adam state becomes non-trivial
+    _step(net, tr, X, Y, lossf)
+
+    def state_bytes(trainer):
+        with tempfile.NamedTemporaryFile(suffix=".states") as f:
+            trainer.save_states(f.name)
+            with open(f.name, "rb") as fh:
+                return fh.read()
+
+    mgr = mx.checkpoint.CheckpointManager(str(tmp_path / "tr"))
+    mgr.save(0, net=net, trainer=tr)
+    want = state_bytes(tr)
+    _step(net, tr, X, Y, lossf)  # mutate optimizer state past the save
+    assert state_bytes(tr) != want
+    step, _ = mgr.restore(net=net, trainer=tr)
+    assert step == 0
+    assert state_bytes(tr) == want  # byte-exact optimizer state round-trip

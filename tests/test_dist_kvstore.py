@@ -22,9 +22,18 @@ def test_two_process_sync_kvstore():
     finally:
         sys.path.pop(0)
     worker = os.path.join(repo, "tests", "_dist_worker.py")
-    env = {"MXNET_TPU_JIT_IMPERATIVE": "1"}
-    codes = launch_local(2, [sys.executable, worker], env_extra=env,
-                         cpu_devices_per_worker=1)
+    # deflake (ISSUE 3 satellite): deadline-bound every blocking dist call
+    # inside the workers (a wedged peer now exits with KVStoreTimeoutError
+    # instead of hanging to the launcher kill), keep the launcher timeout
+    # well under the tier-1 budget, and retry the launch once — the
+    # residual flake is the localhost coordinator rendezvous, which is
+    # process-lifetime state a fresh launch resets.
+    env = {"MXNET_TPU_JIT_IMPERATIVE": "1", "MXNET_KVSTORE_TIMEOUT_S": "60"}
+    for attempt in range(2):
+        codes = launch_local(2, [sys.executable, worker], env_extra=env,
+                             cpu_devices_per_worker=1, timeout=180)
+        if codes == [0, 0]:
+            break
     assert codes == [0, 0], f"worker exit codes {codes}"
 
 

@@ -44,6 +44,7 @@ def test_bert_pretrain_loss_drops():
     assert rec["last_loss"] < rec["first_loss"]
 
 
+@pytest.mark.slow  # compile-heavy; excluded from the tier-1 timing budget
 def test_lstm_lm_perplexity_drops():
     mod = _load("rnn/lstm_lm.py")
     hist = mod.run(vocab=32, emb=16, hidden=32, layers=1, bptt=8,
@@ -142,6 +143,7 @@ def test_transformer_mt_learns():
     assert rec["last_loss"] < rec["first_loss"]
 
 
+@pytest.mark.slow  # compile-heavy; excluded from the tier-1 timing budget
 def test_yolo3_trains_and_detects():
     mod = _load("yolo/train_yolo.py")
     rec = mod.run(batch=8, steps=25, log=False)

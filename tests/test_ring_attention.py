@@ -88,6 +88,7 @@ def test_ring_seg_kv_only_is_honored(seeded):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # compile-heavy; excluded from the tier-1 timing budget
 def test_ring_gradients_match_dense(seeded):
     B, H, L, D, n = 1, 2, 16, 4, 4
     r = np.random.RandomState(2)
