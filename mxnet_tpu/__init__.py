@@ -81,7 +81,8 @@ def __getattr__(name):
               "util", "numpy", "numpy_extension", "contrib", "amp", "module",
               "monitor", "checkpoint", "dmlc_params", "operator",
               "pipeline", "name", "attribute", "rtc", "native",
-              "visualization", "library", "telemetry", "resilience"}
+              "visualization", "library", "telemetry", "resilience",
+              "analysis"}
     if name in lazies:
         mod = _lazy(name)
         globals()[name] = mod

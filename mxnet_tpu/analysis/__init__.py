@@ -1,0 +1,32 @@
+"""mxnet_tpu.analysis — repo-native static analysis (graftcheck).
+
+CLI: ``python tools/graftcheck.py [paths ...]`` (stdlib-only — runs
+before any pip install in CI).  Library surface::
+
+    from mxnet_tpu import analysis
+    findings, suppressed, modules = analysis.analyze_paths(["mxnet_tpu"])
+    with analysis.runtime.no_retrace():
+        step(batch)        # dynamic twin of rule GC02
+
+Rules (see ``passes.py`` and the README "Static analysis" section):
+GC01 host-sync on the hot path, GC02 retrace hazards, GC03 env-knob
+hygiene, GC04 lock discipline, GC05 telemetry-flag discipline.
+Suppress with ``# graftcheck: ignore[GC01] — justification`` (the
+justification is mandatory; a bare ignore is itself a finding).
+"""
+
+from __future__ import annotations
+
+from . import passes  # noqa: F401 — importing registers GC01–GC05
+from . import runtime  # noqa: F401
+from .core import (  # noqa: F401
+    PASSES, Context, Finding, ModuleInfo, Pass, analyze_paths,
+    check_source, main, register_pass,
+)
+from .runtime import RetraceError, no_retrace  # noqa: F401
+
+__all__ = [
+    "Finding", "ModuleInfo", "Context", "Pass", "PASSES", "register_pass",
+    "analyze_paths", "check_source", "main", "runtime", "no_retrace",
+    "RetraceError",
+]

@@ -1,0 +1,495 @@
+"""graftcheck core — repo-native static-analysis framework.
+
+The correctness-tooling analog of the reference's cpplint/sanitizer gates
+(SURVEY §4): the invariants the runtime PRs rely on — hot-path purity, no
+retrace hazards, lock discipline in threaded modules, env-knob hygiene —
+are tribal knowledge unless a machine checks them on every push.  This
+module is the framework: a pluggable pass registry, per-line suppressions
+with mandatory justifications, JSON + human output, a committed-baseline
+diff mode, and an exit-code contract for CI.  The passes themselves live
+in ``passes.py`` (rules GC01–GC05).
+
+Design constraints:
+
+- **stdlib only** — the CI graftcheck lane runs before any pip install,
+  so nothing here (or in passes.py) may import jax, numpy, or the
+  mxnet_tpu runtime.  Config knowledge (``config.KNOWN_VARS``) is read by
+  *parsing* config.py, never importing it.
+- **suppressions carry justifications** — ``# graftcheck: ignore[GC01] — why``
+  on (or immediately above) the flagged line.  A bare ``ignore[...]``
+  with no justification is itself a finding (GC00), so the suppression
+  ledger stays reviewable.
+- **exit codes**: 0 = clean (no unsuppressed findings), 1 = findings,
+  2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+import sys
+
+__all__ = [
+    "Finding", "ModuleInfo", "Context", "Pass", "PASSES", "register_pass",
+    "parse_suppressions", "analyze_paths", "check_source", "main",
+]
+
+# --------------------------------------------------------------------------
+# findings
+# --------------------------------------------------------------------------
+
+
+class Finding:
+    """One rule violation at a source location."""
+
+    __slots__ = ("rule", "path", "line", "message", "source_line")
+
+    def __init__(self, rule, path, line, message, source_line=""):
+        self.rule = rule
+        self.path = path          # repo-relative posix path
+        self.line = int(line)
+        self.message = message
+        self.source_line = source_line
+
+    @property
+    def fingerprint(self):
+        """Content-addressed identity for baseline diffing: stable across
+        unrelated edits that only shift line numbers."""
+        text = self.source_line.strip() or f"line{self.line}"
+        h = hashlib.sha1(
+            f"{self.rule}|{self.path}|{text}".encode()).hexdigest()
+        return h[:16]
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "fingerprint": self.fingerprint}
+
+    def render(self):
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def __repr__(self):
+        return f"<Finding {self.render()}>"
+
+
+# --------------------------------------------------------------------------
+# suppressions
+# --------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftcheck:\s*ignore\[([A-Za-z0-9_,\s]+)\]\s*(?:[-—–:]+\s*(\S.*))?")
+_COMMENT_ONLY_RE = re.compile(r"^\s*(#|$)")
+
+
+def parse_suppressions(lines):
+    """Map line number (1-based) -> (rules, justification, comment_line).
+
+    A suppression on a code line applies to that line; on a comment-only
+    line it applies to the next code line (stacked comment lines chain).
+    A trailing suppression with no code line to govern is kept under the
+    line past EOF so the hygiene checks still see it.
+    """
+    out = {}
+    pending = []  # suppressions waiting for the next code line
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        entry = None
+        if m:
+            rules = frozenset(
+                r.strip().upper() for r in m.group(1).split(",") if r.strip())
+            entry = (rules, (m.group(2) or "").strip(), i)
+        if _COMMENT_ONLY_RE.match(text):
+            if entry:
+                pending.append(entry)
+            continue
+        # a code line: attach its own inline suppression plus any pending
+        here = list(pending)
+        pending = []
+        if entry:
+            here.append(entry)
+        if here:
+            rules = frozenset().union(*(e[0] for e in here))
+            just = "; ".join(e[1] for e in here if e[1])
+            out[i] = (rules, just, here[0][2])
+    if pending:
+        # dangling at EOF: governs nothing, but must not vanish silently
+        rules = frozenset().union(*(e[0] for e in pending))
+        just = "; ".join(e[1] for e in pending if e[1])
+        out[len(lines) + 1] = (rules, just, pending[0][2])
+    return out
+
+
+# --------------------------------------------------------------------------
+# module / project context
+# --------------------------------------------------------------------------
+
+
+class ModuleInfo:
+    """One parsed source file plus its suppression map."""
+
+    def __init__(self, path, rel, text):
+        self.path = path          # display path (repo-relative when known)
+        self.rel = rel            # path relative to the package root, posix
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.suppressions = parse_suppressions(self.lines)
+
+    def line_text(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def finding(self, rule, node_or_line, message):
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Finding(rule, self.path, line, message, self.line_text(line))
+
+
+class Context:
+    """Project-wide state shared by passes: every module, plus the repo /
+    package roots so cross-file rules (knob catalog vs README) can see
+    both sides."""
+
+    def __init__(self, modules, package_root=None, repo_root=None):
+        self.modules = modules
+        self.package_root = package_root
+        self.repo_root = repo_root
+
+    def module(self, rel):
+        for m in self.modules:
+            if m.rel == rel:
+                return m
+        return None
+
+    def read_repo_file(self, name):
+        if not self.repo_root:
+            return None
+        p = os.path.join(self.repo_root, name)
+        try:
+            with open(p, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+# --------------------------------------------------------------------------
+# pass registry
+# --------------------------------------------------------------------------
+
+
+class Pass:
+    """Base class for one rule.  Subclasses set ``rule`` + ``summary`` and
+    implement ``check_module`` (per file) and/or ``check_project``
+    (cross-file, runs once with the full Context)."""
+
+    rule = "GC00"
+    summary = ""
+
+    def check_module(self, module, ctx):  # noqa: ARG002
+        return []
+
+    def check_project(self, ctx):  # noqa: ARG002
+        return []
+
+
+PASSES: list = []
+
+
+def register_pass(cls):
+    """Decorator adding a Pass subclass to the registry (pluggable: any
+    module imported before the run may register more)."""
+    PASSES.append(cls())
+    return cls
+
+
+# --------------------------------------------------------------------------
+# runner
+# --------------------------------------------------------------------------
+
+
+def _iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git", ".claude"))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def _package_rel(path):
+    """Path of a module relative to its enclosing ``mxnet_tpu`` package
+    (what HOT_PATHS / THREADED_MODULES key on); falls back to basename."""
+    parts = os.path.abspath(path).replace(os.sep, "/").split("/")
+    if "mxnet_tpu" in parts:
+        idx = len(parts) - 1 - parts[::-1].index("mxnet_tpu")
+        return "/".join(parts[idx + 1:])
+    return parts[-1]
+
+
+def load_module(path, repo_root=None):
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    display = path
+    if repo_root:
+        try:
+            display = os.path.relpath(path, repo_root).replace(os.sep, "/")
+        except ValueError:
+            pass
+    return ModuleInfo(display, _package_rel(path), text)
+
+
+def _apply_suppressions(module, findings):
+    """Split raw findings into (kept, suppressed) per the module's
+    suppression map.  An unjustified suppression never suppresses (its
+    GC00 comes from _check_suppression_rules, which sees every ignore
+    whether or not a finding matched)."""
+    kept, suppressed = [], []
+    for f in findings:
+        sup = module.suppressions.get(f.line)
+        if sup and f.rule in sup[0] and sup[1]:
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    return kept, suppressed
+
+
+def _check_suppression_rules(module, known_rules):
+    """Hygiene over EVERY ignore[...] comment, matched or not: unknown
+    rule ids are typos that disable nothing, and a missing justification
+    is itself a finding — both keep the suppression ledger reviewable."""
+    out = []
+    seen = set()
+    for line, (rules, just, at) in sorted(module.suppressions.items()):
+        if at in seen:
+            continue
+        seen.add(at)
+        for r in sorted(rules):
+            if r not in known_rules:
+                out.append(module.finding(
+                    "GC00", at, f"unknown rule {r!r} in suppression "
+                    f"(known: {', '.join(sorted(known_rules))})"))
+        if not just:
+            out.append(module.finding(
+                "GC00", at,
+                "suppression has no justification — write "
+                f"'# graftcheck: ignore[{', '.join(sorted(rules))}] — "
+                "why this is safe'"))
+    return out
+
+
+def analyze_paths(paths, repo_root=None):
+    """Run every registered pass over ``paths``.
+
+    Returns (findings, suppressed, modules) — findings are unsuppressed.
+    """
+    modules, errors = [], []
+    for path in _iter_py_files(paths):
+        try:
+            modules.append(load_module(path, repo_root=repo_root))
+        except SyntaxError as e:
+            errors.append(Finding("GC00", path, e.lineno or 0,
+                                  f"syntax error: {e.msg}"))
+    package_root = None
+    for m in modules:
+        if m.rel == "config.py":
+            package_root = os.path.dirname(os.path.abspath(
+                os.path.join(repo_root or ".", m.path)))
+    ctx = Context(modules, package_root=package_root, repo_root=repo_root)
+
+    known_rules = {p.rule for p in PASSES} | {"GC00"}
+    all_kept, all_suppressed = list(errors), []
+    by_module = {id(m): [] for m in modules}
+    for p in PASSES:
+        for m in modules:
+            for f in p.check_module(m, ctx):
+                by_module[id(m)].append(f)
+        for f in p.check_project(ctx):
+            m = next((mm for mm in modules if mm.path == f.path), None)
+            if m is not None:
+                by_module[id(m)].append(f)
+            else:
+                all_kept.append(f)
+    for m in modules:
+        kept, suppressed = _apply_suppressions(m, by_module[id(m)])
+        kept.extend(_check_suppression_rules(m, known_rules))
+        all_kept.extend(kept)
+        all_suppressed.extend(suppressed)
+    all_kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return all_kept, all_suppressed, modules
+
+
+def check_source(source, rel="module.py", path=None):
+    """Test helper: run all passes over one in-memory source snippet as if
+    it lived at ``rel`` inside the mxnet_tpu package.  Returns
+    (findings, suppressed)."""
+    module = ModuleInfo(path or rel, rel, source)
+    ctx = Context([module])
+    known_rules = {p.rule for p in PASSES} | {"GC00"}
+    raw = []
+    for p in PASSES:
+        raw.extend(p.check_module(module, ctx))
+        raw.extend(p.check_project(ctx))
+    kept, suppressed = _apply_suppressions(module, raw)
+    kept.extend(_check_suppression_rules(module, known_rules))
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept, suppressed
+
+
+# --------------------------------------------------------------------------
+# baseline
+# --------------------------------------------------------------------------
+
+
+def load_baseline(path):
+    """Baseline as a MULTISET {(rule, path, fingerprint): count} —
+    identical-text findings share a fingerprint, so each baseline entry
+    must excuse exactly one occurrence or a copy-pasted new violation
+    would hide behind an old one."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    counts: dict = {}
+    for e in data.get("findings", []):
+        k = (e["rule"], e["path"], e["fingerprint"])
+        counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+def write_baseline(path, findings):
+    data = {
+        "comment": "graftcheck baseline — known findings new code is "
+                   "diffed against; regenerate with --write-baseline",
+        "findings": [
+            {"rule": f.rule, "path": f.path, "fingerprint": f.fingerprint,
+             "message": f.message}
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+_USAGE = """\
+usage: graftcheck.py [paths ...] [options]
+
+Repo-native static analysis: hot-path purity (GC01), retrace hazards
+(GC02), env-knob hygiene (GC03), lock discipline (GC04), telemetry-flag
+discipline (GC05).  Default path: the mxnet_tpu package next to tools/.
+
+options:
+  --json                 machine-readable findings on stdout
+  --list-rules           print the rule table and exit
+  --baseline FILE        ignore findings recorded in FILE (diff mode)
+  --write-baseline FILE  write current findings to FILE and exit 0
+  -q, --quiet            suppress the summary line
+"""
+
+
+def main(argv=None, repo_root=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = quiet = False
+    baseline_path = write_baseline_path = None
+    paths = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-h", "--help"):
+            print(_USAGE)
+            return 0
+        if a == "--json":
+            as_json = True
+        elif a in ("-q", "--quiet"):
+            quiet = True
+        elif a == "--list-rules":
+            for p in PASSES:
+                print(f"{p.rule}  {p.summary}")
+            return 0
+        elif a == "--baseline":
+            i += 1
+            if i >= len(argv):
+                print("--baseline needs a file", file=sys.stderr)
+                return 2
+            baseline_path = argv[i]
+        elif a == "--write-baseline":
+            i += 1
+            if i >= len(argv):
+                print("--write-baseline needs a file", file=sys.stderr)
+                return 2
+            write_baseline_path = argv[i]
+        elif a.startswith("-"):
+            print(f"unknown option {a!r}\n{_USAGE}", file=sys.stderr)
+            return 2
+        else:
+            paths.append(a)
+        i += 1
+
+    if repo_root is None:
+        repo_root = os.getcwd()
+    if not paths:
+        default = os.path.join(repo_root, "mxnet_tpu")
+        if not os.path.isdir(default):
+            print("no paths given and no ./mxnet_tpu found", file=sys.stderr)
+            return 2
+        paths = [default]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    try:
+        findings, suppressed, modules = analyze_paths(paths,
+                                                      repo_root=repo_root)
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"graftcheck internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if write_baseline_path:
+        write_baseline(write_baseline_path, findings)
+        if not quiet:
+            print(f"wrote {len(findings)} finding(s) to "
+                  f"{write_baseline_path}")
+        return 0
+
+    if baseline_path:
+        try:
+            base = load_baseline(baseline_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"cannot read baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        remaining, kept = dict(base), []
+        for f in findings:
+            k = (f.rule, f.path, f.fingerprint)
+            if remaining.get(k):
+                remaining[k] -= 1  # each entry excuses ONE occurrence
+            else:
+                kept.append(f)
+        findings = kept
+
+    if as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "suppressed": len(suppressed),
+            "files": len(modules),
+        }, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.render())
+        if not quiet:
+            print(f"graftcheck: {len(findings)} finding(s), "
+                  f"{len(suppressed)} suppressed, {len(modules)} file(s)"
+                  + (" [vs baseline]" if baseline_path else ""))
+    return 1 if findings else 0

@@ -1,0 +1,816 @@
+"""graftcheck rules GC01–GC05.
+
+Each pass encodes an invariant the runtime subsystems (telemetry PR 1,
+gradient fusion PR 2, resilience PR 3) depend on but nothing previously
+enforced:
+
+- **GC01 host-sync**: the dispatch/fusion hot path must not silently sync
+  device → host.  Flags ``.item()`` / ``.asnumpy()`` /
+  ``.block_until_ready()`` / ``waitall()`` / ``jax.device_get`` anywhere
+  in a designated hot-path function, and ``float()/int()/bool()/len()`` /
+  ``np.asarray`` applied to traced/jax values (tracked by a small local
+  dataflow over ``._data`` / ``jnp.*`` producers).
+- **GC02 retrace-hazard**: functions handed to ``jax.jit`` must not close
+  over mutable state (``self``, rebindable module globals, reassigned
+  enclosing locals) — stale values get baked into cached traces; and jit
+  results must be cached, not built per call.  Mutable-literal defaults
+  and untyped ``**kwargs`` reaching a trace (bypassing ``_freeze`` /
+  ``static_argnames``) are the quiet version of the same bug.
+- **GC03 knob-hygiene**: every ``MXNET_*`` env read outside ``config.py``
+  is ungoverned (no default, no type, no docs); every knob registered in
+  ``config.KNOWN_VARS`` must appear in the README knob table.
+- **GC04 lock-discipline**: in the threaded modules, an attribute or
+  module global written under ``with <lock>`` in one function and
+  written lock-free in another is a data race waiting for a scheduler.
+- **GC05 telemetry-flag discipline**: hot-path functions read the
+  telemetry-enabled flag at most once (snapshot it; re-reads both waste
+  cycles and can observe a mid-call flip, tearing paired begin/end
+  instrumentation).
+"""
+
+from __future__ import annotations
+
+import ast
+import symtable
+
+from .core import Pass, register_pass
+
+# --------------------------------------------------------------------------
+# designated scopes
+# --------------------------------------------------------------------------
+
+# Hot-path purity scope (GC01/GC05): module rel-path -> function names, or
+# None meaning every function in the module is hot.
+HOT_PATHS = {
+    "ops/registry.py": {"invoke", "invoke_arrays", "_apply_cast",
+                        "_callable_for", "_build_callable", "_normalize_out"},
+    "kvstore/fusion.py": None,
+    "kvstore/local.py": {"_reduce", "_reduce_rowsparse", "_store_merged",
+                         "push", "pull", "pushpull", "pushpull_list",
+                         "_fused_pushpull"},
+    "gluon/trainer.py": {"step", "_allreduce_grads", "_update",
+                         "_update_impl", "_update_aggregated"},
+}
+
+# GC05 additionally audits these (they sit on the per-batch/per-call path
+# even though they are not purity-critical).
+FLAG_DISCIPLINE_MODULES = set(HOT_PATHS) | {
+    "gluon/data/dataloader.py", "kvstore/dist.py",
+}
+
+# Threaded modules (GC04): rel-path prefixes.  These own locks or run user
+# code on worker threads.
+THREADED_MODULES = (
+    "engine.py", "native.py", "profiler.py", "checkpoint.py",
+    "ops/registry.py", "telemetry/", "resilience/",
+    "gluon/data/dataloader.py", "kvstore/sparse_ps.py",
+)
+
+
+def _dotted(expr):
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_threaded(rel):
+    return any(rel == t or (t.endswith("/") and rel.startswith(t))
+               for t in THREADED_MODULES)
+
+
+def _walk_shallow(fn):
+    """Yield nodes of ``fn``'s body without descending into nested
+    function definitions (those are analyzed as their own scopes)."""
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _hot_functions(module):
+    """Yield (qualname, FunctionDef) for every designated hot function in
+    the module (nested defs inside a hot function are hot too)."""
+    spec = HOT_PATHS.get(module.rel)
+    if module.rel not in HOT_PATHS:
+        return
+
+    def walk(node, prefix, inside_hot):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                hot = inside_hot or spec is None or child.name in spec
+                if hot:
+                    yield qual, child
+                yield from walk(child, qual + ".", hot)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.", inside_hot)
+
+    yield from walk(module.tree, "", False)
+
+
+# --------------------------------------------------------------------------
+# GC01 — host-sync on the hot path
+# --------------------------------------------------------------------------
+
+_SYNC_METHODS = {"item", "asnumpy", "block_until_ready"}
+_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+_NUMPY_ROOTS = {"np", "_np", "numpy", "onp"}
+_JAX_PRODUCER_ROOTS = {"jnp", "lax"}
+_CAST_BUILTINS = {"float", "int", "bool", "len"}
+
+
+def _expr_arrayish(expr, names):
+    """Syntactic 'holds a jax/traced array' judgment."""
+    if isinstance(expr, ast.Name):
+        return expr.id in names
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in ("_data", "_grad"):
+            return True
+        return False
+    if isinstance(expr, ast.Subscript):
+        return _expr_arrayish(expr.value, names)
+    if isinstance(expr, ast.BinOp):
+        return (_expr_arrayish(expr.left, names)
+                or _expr_arrayish(expr.right, names))
+    if isinstance(expr, ast.UnaryOp):
+        return _expr_arrayish(expr.operand, names)
+    if isinstance(expr, ast.Call):
+        d = _dotted(expr.func)
+        if d:
+            root = d.split(".")[0]
+            if root in _JAX_PRODUCER_ROOTS:
+                return True
+            if root == "jax" and d not in ("jax.jit",):
+                return True
+            if d == "tree_sum" or d.endswith(".tree_sum"):
+                return True
+        # method on an arrayish object returns arrayish (e.g. x.reshape)
+        if isinstance(expr.func, ast.Attribute):
+            return _expr_arrayish(expr.func.value, names)
+    return False
+
+
+@register_pass
+class HostSyncPass(Pass):
+    rule = "GC01"
+    summary = ("host-sync on the hot path: .item()/.asnumpy()/waitall()/"
+               "device_get, or float/int/bool/len/np.asarray on a traced "
+               "value, inside a designated hot-path function")
+
+    def check_module(self, module, ctx):
+        out = []
+        for qual, fn in _hot_functions(module):
+            out.extend(self._check_function(module, qual, fn))
+        return out
+
+    def _check_function(self, module, qual, fn):
+        out = []
+        nodes = list(_walk_shallow(fn))
+        # dataflow to fixpoint: x = <arrayish expr> tags x (iterated so
+        # traversal order doesn't matter)
+        arrayish = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in nodes:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id not in arrayish \
+                        and _expr_arrayish(node.value, arrayish):
+                    arrayish.add(node.targets[0].id)
+                    changed = True
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_METHODS and not node.args:
+                out.append(module.finding(
+                    self.rule, node,
+                    f"host-sync: .{node.func.attr}() in hot path "
+                    f"{qual!r} blocks dispatch until the device flushes"))
+                continue
+            if d in _SYNC_CALLS or (d and d.split(".")[-1] == "waitall"):
+                out.append(module.finding(
+                    self.rule, node,
+                    f"host-sync: {d}() in hot path {qual!r} drains the "
+                    "async dispatch queue"))
+                continue
+            if d and "." in d and d.split(".")[0] in _NUMPY_ROOTS \
+                    and d.split(".")[-1] in ("asarray", "array") \
+                    and node.args \
+                    and _expr_arrayish(node.args[0], arrayish):
+                out.append(module.finding(
+                    self.rule, node,
+                    f"host-sync: {d}() on a traced/jax value in hot path "
+                    f"{qual!r} copies device memory to host"))
+                continue
+            if d in _CAST_BUILTINS and len(node.args) == 1 \
+                    and _expr_arrayish(node.args[0], arrayish):
+                out.append(module.finding(
+                    self.rule, node,
+                    f"host-sync: {d}() on a traced/jax value in hot path "
+                    f"{qual!r} forces a device->host transfer (and fails "
+                    "under trace)"))
+        return out
+
+
+# --------------------------------------------------------------------------
+# GC02 — retrace hazards
+# --------------------------------------------------------------------------
+
+
+class _Scope:
+    __slots__ = ("node", "parent", "defs", "bindings", "mutated",
+                 "globals_declared")
+
+    def __init__(self, node, parent):
+        self.node = node
+        self.parent = parent
+        self.defs = {}          # name -> FunctionDef/Lambda node
+        self.bindings = {}      # name -> count of binding sites
+        self.mutated = set()    # names target of AugAssign
+        self.globals_declared = set()
+
+    def bind(self, name, n=1):
+        self.bindings[name] = self.bindings.get(name, 0) + n
+
+
+def _collect_scopes(tree):
+    """Scope table: id(function node) -> _Scope, plus the module scope
+    under key None.  Bindings are counted per scope (params, assignments,
+    defs, imports); AugAssign marks a name mutated."""
+    scopes = {}
+
+    def bind_target(scope, tgt):
+        if isinstance(tgt, ast.Name):
+            scope.bind(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                bind_target(scope, e)
+        elif isinstance(tgt, ast.Starred):
+            bind_target(scope, tgt.value)
+
+    def visit_body(scope, body):
+        for node in body:
+            visit(scope, node)
+
+    def visit(scope, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope.defs[node.name] = node
+            scope.bind(node.name)
+            sub = _Scope(node, scope)
+            scopes[id(node)] = sub
+            a = node.args
+            for p in (a.posonlyargs + a.args + a.kwonlyargs):
+                sub.bind(p.arg)
+            for p in (a.vararg, a.kwarg):
+                if p is not None:
+                    sub.bind(p.arg)
+            # defaults/decorators evaluate in the parent scope
+            for d in list(a.defaults) + [x for x in a.kw_defaults if x] \
+                    + list(node.decorator_list):
+                visit(scope, d)
+            visit_body(sub, node.body)
+            return
+        if isinstance(node, ast.Lambda):
+            sub = _Scope(node, scope)
+            scopes[id(node)] = sub
+            a = node.args
+            for p in (a.posonlyargs + a.args + a.kwonlyargs):
+                sub.bind(p.arg)
+            for p in (a.vararg, a.kwarg):
+                if p is not None:
+                    sub.bind(p.arg)
+            visit(sub, node.body)
+            return
+        if isinstance(node, ast.ClassDef):
+            scope.bind(node.name)
+            # class body binds in its own namespace; methods' enclosing
+            # *function* scope chain skips it, so hang methods off the
+            # current scope for resolution purposes
+            visit_body(scope, node.body)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                bind_target(scope, t)
+        elif isinstance(node, ast.AnnAssign) and node.target is not None:
+            bind_target(scope, node.target)
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name):
+                scope.bind(node.target.id)
+                scope.mutated.add(node.target.id)
+        elif isinstance(node, ast.NamedExpr):
+            bind_target(scope, node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            bind_target(scope, node.target)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                scope.bind((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, ast.Global):
+            scope.globals_declared.update(node.names)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            scope.bind(node.name)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    bind_target(scope, item.optional_vars)
+        for child in ast.iter_child_nodes(node):
+            visit(scope, child)
+
+    mod = _Scope(None, None)
+    scopes[None] = mod
+    visit_body(mod, tree.body)
+    return scopes
+
+
+def _mutable_globals(scopes):
+    """Module-level names that are rebound after their first binding:
+    assigned ≥2 times at module scope, augmented, or assigned inside any
+    function that declares them ``global``."""
+    mod = scopes[None]
+    out = {n for n, c in mod.bindings.items() if c >= 2}
+    out |= mod.mutated
+    for s in scopes.values():
+        if s is mod or s is None:
+            continue
+        for n in s.globals_declared:
+            if s.bindings.get(n):
+                out.add(n)
+    return out
+
+
+def _symtable_index(text, path):
+    """(name, lineno) -> symtable entry for every function scope; None on
+    any symtable failure (the pass then skips free/global analysis)."""
+    try:
+        top = symtable.symtable(text, path, "exec")
+    except (SyntaxError, ValueError):
+        return None
+    index = {}
+
+    def walk(tb):
+        for child in tb.get_children():
+            if child.get_type() == "function":
+                index.setdefault((child.get_name(), child.get_lineno()),
+                                 child)
+            walk(child)
+
+    walk(top)
+    return index
+
+
+_JIT_SAFE_KWARGS = {
+    "static_argnums", "static_argnames", "donate_argnums", "donate_argnames",
+    "in_shardings", "out_shardings", "device", "backend", "keep_unused",
+    "inline", "abstracted_axes",
+}
+
+
+@register_pass
+class RetraceHazardPass(Pass):
+    rule = "GC02"
+    summary = ("retrace hazard: jitted closure captures mutable state "
+               "(self / rebindable global / reassigned local), jit built "
+               "per call, mutable-literal defaults, or **kwargs reaching "
+               "a trace without static_argnames/_freeze")
+
+    def check_module(self, module, ctx):
+        scopes = _collect_scopes(module.tree)
+        mutable_globals = _mutable_globals(scopes)
+        st_index = _symtable_index(module.text, module.path)
+        out = []
+
+        def resolve(name, scope):
+            s = scope
+            while s is not None:
+                if name in s.defs:
+                    return s.defs[name], s
+                s = s.parent
+            return None, None
+
+        def walk(node, scope):
+            for child in ast.iter_child_nodes(node):
+                sub = scopes.get(id(child))
+                if isinstance(child, ast.Call):
+                    self._check_call(module, child, scope, scopes,
+                                     mutable_globals, st_index, resolve, out)
+                walk(child, sub if sub is not None else scope)
+
+        walk(module.tree, scopes[None])
+        return out
+
+    @staticmethod
+    def _is_jit(func):
+        d = _dotted(func)
+        return d in ("jax.jit", "jit", "pjit", "jax.pjit")
+
+    def _check_call(self, module, call, scope, scopes, mutable_globals,
+                    st_index, resolve, out):
+        # jax.jit(f)(...) — a fresh compile every execution
+        if isinstance(call.func, ast.Call) and self._is_jit(call.func.func):
+            out.append(module.finding(
+                self.rule, call,
+                "jax.jit(...) built and invoked in one expression — the "
+                "executable is rebuilt (and retraced) on every call; cache "
+                "it keyed on shape/dtype/static attrs"))
+            return
+        if not self._is_jit(call.func):
+            return
+        target = call.args[0] if call.args else None
+        fnode = None
+        if isinstance(target, ast.Lambda):
+            fnode = target
+        elif isinstance(target, ast.Name):
+            fnode, _def_scope = resolve(target.id, scope)
+        if fnode is None:
+            return  # call-expression target: not statically resolvable
+
+        # (c) mutable-literal defaults are baked into the trace object
+        # identity — they bypass any _freeze()-style cache key
+        if not isinstance(fnode, ast.Lambda) or fnode.args.defaults:
+            a = fnode.args
+            for dflt in list(a.defaults) + [x for x in a.kw_defaults if x]:
+                if isinstance(dflt, (ast.Dict, ast.List, ast.Set,
+                                     ast.DictComp, ast.ListComp,
+                                     ast.SetComp)):
+                    out.append(module.finding(
+                        self.rule, call,
+                        "jitted function has a mutable-literal default — "
+                        "its contents are baked into the first trace and "
+                        "never revalidated; freeze it into the jit cache "
+                        "key instead"))
+                    break
+
+        # (d) **kwargs reaching the trace untyped
+        if getattr(fnode.args, "kwarg", None) is not None:
+            kw_names = {k.arg for k in call.keywords}
+            if not (kw_names & {"static_argnames", "static_argnums"}):
+                out.append(module.finding(
+                    self.rule, call,
+                    "jitted function takes **kwargs with no "
+                    "static_argnames/static_argnums — non-array kwargs "
+                    "bypass _freeze and either retrace per value or fail "
+                    "to hash"))
+
+        # (a)/(b) closure captures
+        fscope = scopes.get(id(fnode))
+        st = None
+        if st_index is not None and not isinstance(fnode, ast.Lambda):
+            st = st_index.get((fnode.name, fnode.lineno))
+        if st is not None:
+            frees = set(st.get_frees())
+            globs = set(st.get_globals())
+        else:
+            frees, globs = self._approx_names(fnode, fscope)
+        for name in sorted(frees):
+            if name in ("self", "cls"):
+                out.append(module.finding(
+                    self.rule, call,
+                    f"jitted closure captures {name!r} — instance state "
+                    "read at trace time is baked into the cached "
+                    "executable and goes stale silently"))
+                continue
+            bscope = fscope.parent if fscope else None
+            while bscope is not None and not bscope.bindings.get(name):
+                bscope = bscope.parent
+            if bscope is not None and (
+                    bscope.bindings.get(name, 0) >= 2
+                    or name in bscope.mutated):
+                out.append(module.finding(
+                    self.rule, call,
+                    f"jitted closure captures {name!r}, which is "
+                    "reassigned in the enclosing scope — the trace keeps "
+                    "the value from trace time, not call time; pass it as "
+                    "an argument or bind it as a default"))
+        for name in sorted(globs):
+            if name in mutable_globals:
+                out.append(module.finding(
+                    self.rule, call,
+                    f"jitted closure reads module global {name!r}, which "
+                    "is rebound elsewhere — the cached trace freezes one "
+                    "value forever; thread it through arguments or the "
+                    "cache key"))
+
+    @staticmethod
+    def _approx_names(fnode, fscope):
+        """Fallback free/global split when symtable indexing failed: every
+        Load of a name not bound locally, attributed to 'free' if an
+        enclosing function scope binds it, else 'global'."""
+        loads = {n.id for n in ast.walk(fnode)
+                 if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+        local = set(fscope.bindings) if fscope else set()
+        frees, globs = set(), set()
+        for name in loads - local:
+            s = fscope.parent if fscope else None
+            hit = False
+            while s is not None and s.node is not None:
+                if s.bindings.get(name):
+                    hit = True
+                    break
+                s = s.parent
+            (frees if hit else globs).add(name)
+        return frees, globs
+
+
+# --------------------------------------------------------------------------
+# GC03 — env-knob hygiene
+# --------------------------------------------------------------------------
+
+
+@register_pass
+class KnobHygienePass(Pass):
+    rule = "GC03"
+    summary = ("knob hygiene: MXNET_* env reads outside config.py; knobs "
+               "registered in config.KNOWN_VARS but missing from the "
+               "README knob table")
+
+    def check_module(self, module, ctx):
+        if module.rel == "config.py":
+            return []
+        out = []
+        for node in ast.walk(module.tree):
+            knob, how = self._env_read(node)
+            if knob and knob.startswith("MXNET_"):
+                out.append(module.finding(
+                    self.rule, node,
+                    f"ungoverned env read {how}({knob!r}) — route it "
+                    "through mxnet_tpu.config (register the knob in "
+                    "KNOWN_VARS so it is typed, defaulted, and "
+                    "documented)"))
+        return out
+
+    @staticmethod
+    def _env_read(node):
+        """(knob, 'os.environ.get'|...) when node reads a string-literal
+        env var, else (None, None)."""
+        def lit(e):
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                return e.value
+            # computed names ("MXNET_X" if cond else "MXNET_Y",
+            # "MXNET_" + suffix, f-strings): any embedded MXNET_* literal
+            # marks the read as knob-shaped
+            for sub in ast.walk(e):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str) \
+                        and sub.value.startswith("MXNET_"):
+                    return sub.value
+            return None
+
+        if isinstance(node, ast.Subscript):
+            d = _dotted(node.value)
+            if d and d.split(".")[-1] == "environ":
+                return lit(node.slice), d + "[...]"
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if not d:
+                return None, None
+            leaf = d.split(".")[-1]
+            if leaf == "getenv" and node.args:
+                return lit(node.args[0]), d
+            if leaf in ("get", "setdefault", "pop") \
+                    and isinstance(node.func, ast.Attribute):
+                base = _dotted(node.func.value)
+                if base and base.split(".")[-1] == "environ" and node.args:
+                    return lit(node.args[0]), d
+        return None, None
+
+    def check_project(self, ctx):
+        cfg = ctx.module("config.py")
+        if cfg is None:
+            return []
+        readme = ctx.read_repo_file("README.md")
+        if readme is None:
+            return []
+        out = []
+        for name, lineno in self._known_vars(cfg.tree):
+            if name not in readme:
+                out.append(cfg.finding(
+                    self.rule, lineno,
+                    f"knob {name} is registered in config.KNOWN_VARS but "
+                    "undocumented — add it to the README env-knob table"))
+        return out
+
+    @staticmethod
+    def _known_vars(tree):
+        for node in tree.body:
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name) and t.id == "KNOWN_VARS"
+                            for t in node.targets) \
+                    and isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        yield k.value, k.lineno
+                return
+
+
+# --------------------------------------------------------------------------
+# GC04 — lock discipline
+# --------------------------------------------------------------------------
+
+
+def _looks_like_lock(expr):
+    d = _dotted(expr)
+    if not d:
+        return False
+    leaf = d.split(".")[-1].lower()
+    return "lock" in leaf or "mutex" in leaf
+
+
+@register_pass
+class LockDisciplinePass(Pass):
+    rule = "GC04"
+    summary = ("lock discipline: attribute/global written under a lock in "
+               "one function of a threaded module but written lock-free "
+               "in another")
+
+    # functions whose writes construct the object / tear it down before or
+    # after any concurrent access exists
+    _EXEMPT = {"__init__", "__new__", "__init_subclass__"}
+
+    def check_module(self, module, ctx):
+        if not _is_threaded(module.rel):
+            return []
+        module_globals = self._module_level_names(module.tree)
+        # key -> list of (funcname, locked, lineno); key is
+        # ("self", class, attr) or ("global", name)
+        writes = {}
+
+        def record(key, func, locked, lineno):
+            writes.setdefault(key, []).append((func, locked, lineno))
+
+        def scan_function(fn, cls, qual):
+            declared_global = {
+                n for node in ast.walk(fn) if isinstance(node, ast.Global)
+                for n in node.names}
+
+            def key_for(target):
+                base = target
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Attribute) \
+                        and isinstance(base.value, ast.Name) \
+                        and base.value.id == "self":
+                    return ("self", cls, base.attr)
+                if isinstance(base, ast.Name):
+                    if base.id in declared_global \
+                            or (isinstance(target, ast.Subscript)
+                                and base.id in module_globals):
+                        return ("global", base.id)
+                if isinstance(base, ast.Attribute) \
+                        and isinstance(base.value, ast.Name) \
+                        and base.value.id in module_globals \
+                        and not isinstance(target, ast.Attribute):
+                    # mutation through a module-global container attr
+                    return ("global", base.value.id)
+                return None
+
+            def visit(node, locked):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)) and node is not fn:
+                    return  # nested defs execute later, in their own calls
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    now = locked or any(
+                        _looks_like_lock(item.context_expr.func
+                                         if isinstance(item.context_expr,
+                                                       ast.Call)
+                                         else item.context_expr)
+                        for item in node.items)
+                    for item in node.items:
+                        visit(item.context_expr, locked)
+                    for st in node.body:
+                        visit(st, now)
+                    return
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AugAssign):
+                    targets = [node.target]
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None:
+                    targets = [node.target]
+                for t in targets:
+                    k = key_for(t)
+                    if k is not None:
+                        record(k, qual, locked, node.lineno)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, locked)
+
+            visit(fn, False)
+
+        for node in module.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name not in self._EXEMPT:
+                    scan_function(node, None, node.name)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)) \
+                            and sub.name not in self._EXEMPT:
+                        scan_function(sub, node.name,
+                                      f"{node.name}.{sub.name}")
+
+        out = []
+        for key, events in sorted(writes.items(), key=str):
+            locked_funcs = {f for f, locked, _ in events if locked}
+            if not locked_funcs:
+                continue
+            what = (f"self.{key[2]} (class {key[1]})" if key[0] == "self"
+                    else f"module global {key[1]!r}")
+            for func, locked, lineno in events:
+                if not locked and func not in locked_funcs:
+                    out.append(_mk_gc04(self.rule, key, what, func,
+                                        locked_funcs, lineno, module))
+        return out
+
+    @staticmethod
+    def _module_level_names(tree):
+        names = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                names.add(node.target.id)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    names.add((alias.asname or alias.name).split(".")[0])
+        return names
+
+
+def _mk_gc04(rule, key, what, func, locked_funcs, lineno, module):
+    holders = ", ".join(sorted(locked_funcs))
+    return module.finding(
+        rule, lineno,
+        f"lock-free write to {what} in {func!r}, but {holders} write(s) "
+        "it under a lock — take the same lock here or document why the "
+        "race is benign")
+
+
+# --------------------------------------------------------------------------
+# GC05 — telemetry-flag discipline
+# --------------------------------------------------------------------------
+
+
+@register_pass
+class TelemetryFlagPass(Pass):
+    rule = "GC05"
+    summary = ("telemetry-flag discipline: a hot-path function reads the "
+               "telemetry-enabled flag more than once (snapshot it once; "
+               "re-reads can observe a mid-call flip and tear paired "
+               "instrumentation)")
+
+    def check_module(self, module, ctx):
+        if module.rel not in FLAG_DISCIPLINE_MODULES:
+            return []
+        out = []
+        for qual, fn in self._functions(module):
+            reads = []
+            for node in _walk_shallow(fn):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr == "_ENABLED" \
+                        and isinstance(node.ctx, ast.Load):
+                    reads.append(node)
+                elif isinstance(node, ast.Name) and node.id == "_ENABLED" \
+                        and isinstance(node.ctx, ast.Load):
+                    reads.append(node)
+                elif isinstance(node, ast.Call):
+                    d = _dotted(node.func)
+                    if d and d.split(".")[-1] == "enabled":
+                        reads.append(node)
+            reads.sort(key=lambda n: (n.lineno, n.col_offset))
+            if len(reads) >= 2:
+                out.append(module.finding(
+                    self.rule, reads[1],
+                    f"{qual!r} reads the telemetry-enabled flag "
+                    f"{len(reads)} times — snapshot it once at entry "
+                    "(enabled = tracer._ENABLED) and branch on the local"))
+        return out
+
+    @staticmethod
+    def _functions(module):
+        def walk(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    yield f"{prefix}{child.name}", child
+                    # nested defs audited independently
+                    yield from walk(child, f"{prefix}{child.name}.")
+                elif isinstance(child, ast.ClassDef):
+                    yield from walk(child, f"{prefix}{child.name}.")
+
+        yield from walk(module.tree, "")

@@ -1,0 +1,80 @@
+"""Runtime twins of the static rules — assertions for tests.
+
+``no_retrace()`` is the dynamic half of GC02: the static pass proves a
+jitted closure *can't* silently capture mutable state; this context
+manager proves a steady-state region *didn't* compile anything.  It
+counts XLA backend compilations via ``jax.monitoring`` (every
+``jax.jit`` cache miss records ``/jax/core/compile/
+backend_compile_duration``) and raises ``RetraceError`` if the count
+grew inside the guarded block::
+
+    step(batch)                     # warm-up: traces + compiles
+    with no_retrace():
+        step(batch)                 # steady state: must be a cache hit
+
+Zero overhead beyond one listener registered on first use; safe to nest.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["RetraceError", "no_retrace", "compile_count"]
+
+
+class RetraceError(AssertionError):
+    """A region guarded by ``no_retrace()`` triggered XLA compilation."""
+
+
+_lock = threading.Lock()
+_installed = False
+_compiles = 0
+
+# every jit/pjit cache miss records exactly one backend compile under
+# this key (jax 0.4.x); trace-only events are not counted because a
+# pure re-trace that hits the executable cache is not a perf cliff
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _install():
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        import jax.monitoring as _monitoring
+
+        def _on_duration(key, duration, **kwargs):  # noqa: ARG001
+            global _compiles
+            if key == _COMPILE_EVENT:
+                with _lock:
+                    _compiles += 1
+
+        _monitoring.register_event_duration_secs_listener(_on_duration)
+        _installed = True
+
+
+def compile_count():
+    """Total XLA backend compiles observed since the listener was
+    installed (monotonic; install happens on first call)."""
+    _install()
+    return _compiles
+
+
+@contextlib.contextmanager
+def no_retrace(allow=0):
+    """Assert the wrapped block performs no XLA compilation.
+
+    ``allow`` tolerates that many compiles (e.g. a first-call span that
+    legitimately builds one executable).  Raises RetraceError naming the
+    overshoot — the runtime analog of a GC02 finding.
+    """
+    before = compile_count()
+    yield
+    grew = compile_count() - before
+    if grew > allow:
+        raise RetraceError(
+            f"no_retrace: {grew} XLA compilation(s) inside a steady-state "
+            f"region (allowed {allow}) — a jit cache key is unstable "
+            "(shape/dtype/static-attr churn) or a closure captured state "
+            "that changed; see graftcheck rule GC02")

@@ -126,6 +126,40 @@ KNOWN_VARS = {
         "Comma list of faults to arm when MXNET_CHAOS=1: "
         "'site:kind[:times[:delay_s]]' with kind in "
         "delay|transient|fatal|exit, e.g. 'kvstore.allreduce:transient:2'."),
+    # distributed bring-up (tools/launch.py writes these per worker; the
+    # dist kvstore reads them at _ensure_dist)
+    "MXNET_DIST_COORDINATOR": (
+        None, str,
+        "host:port of the jax.distributed rendezvous coordinator "
+        "(JAX_COORDINATOR_ADDRESS also honored); unset = single-process."),
+    "MXNET_DIST_NUM_WORKERS": (
+        "1", int, "World size the dist kvstore rendezvous waits for."),
+    "MXNET_DIST_RANK": (
+        "0", int, "This worker's process id in the dist kvstore world."),
+    # optimizer aggregation (reference MXNET_OPTIMIZER_AGGREGATION_SIZE)
+    "MXNET_OPTIMIZER_AGGREGATION_SIZE": (
+        "4", int,
+        "Max same-dtype params fused into one multi-tensor optimizer "
+        "dispatch (multi_sgd_update family); 1 disables aggregation."),
+    # native (C++) fast lanes
+    "MXNET_USE_NATIVE": (
+        "1", int,
+        "0 disables the native recordio scanner / fused JPEG decoder "
+        "outright (pure-python fallbacks everywhere)."),
+    "MXNET_NATIVE_CACHE": (
+        None, str,
+        "Directory for on-demand-compiled native libraries when the "
+        "package dir is read-only (default ~/.cache/mxnet_tpu)."),
+    # flash-attention kernel tuning (single-tile kernels only)
+    "MXNET_FLASH_BLOCK_H_FWD": (
+        None, int,
+        "Force the head-block size of the single-tile flash-attention "
+        "FORWARD kernel (must divide the head count; non-divisors fall "
+        "through to the auto pick). Unset = VMEM-budget auto-tune."),
+    "MXNET_FLASH_BLOCK_H_BWD": (
+        None, int,
+        "Force the head-block size of the single-tile flash-attention "
+        "BACKWARD kernel (same divisibility contract as _FWD)."),
 }
 
 _lock = threading.Lock()

@@ -314,6 +314,11 @@ class CachedOp:
                 # vjp accepts a bare cotangent
                 return all_out if len(all_out) > 1 else all_out[0]
 
+        # graftcheck: ignore[GC02] — deliberate CachedOp protocol: raw
+        # reads self.block/params at trace time, and the per-shape cache is
+        # keyed on (shapes, dtypes, train_mode) + cleared on dispatch-epoch
+        # bumps (amp toggles), so no stale capture survives; mutated_idx /
+        # key_uses are trace-time out-params, not runtime state
         jitted = jax.jit(raw)
         # abstract trace now so mutated_idx and the output count are known
         key0 = jax.random.PRNGKey(0)

@@ -76,10 +76,10 @@ def _pick_block_h(H, bq, bk, single_tile=False):
     the fused BWD holds s/p/dp/ds simultaneously — hb=4 there needs
     16.3M scoped vmem against the 16.0M in-context limit (measured OOM
     inside the full train step), so bwd gets 3MB → hb=3."""
-    import os
     if single_tile:   # knobs apply ONLY to the single-tile kernels — the
         # streaming grids carry running scratch the forced tile would blow
-        forced = os.environ.get(
+        from .. import config
+        forced = config.get(
             "MXNET_FLASH_BLOCK_H_BWD" if single_tile == "bwd"
             else "MXNET_FLASH_BLOCK_H_FWD")
         if forced and H % int(forced) == 0:

@@ -148,11 +148,11 @@ class KVStoreDistTPUSync(KVStoreLocal):
         import jax
         # Under a pod launcher these env vars are set (tools/launch.py analog
         # writes them); single-process fallback keeps tests runnable anywhere.
-        coord = os.environ.get("MXNET_DIST_COORDINATOR") \
+        coord = config.get("MXNET_DIST_COORDINATOR") \
             or os.environ.get("JAX_COORDINATOR_ADDRESS")
         if coord and jax.process_count() == 1:
-            nproc = int(os.environ.get("MXNET_DIST_NUM_WORKERS", "1"))
-            rank = int(os.environ.get("MXNET_DIST_RANK", "0"))
+            nproc = config.get_int("MXNET_DIST_NUM_WORKERS", 1)
+            rank = config.get_int("MXNET_DIST_RANK", 0)
             kwargs = dict(coordinator_address=coord, num_processes=nproc,
                           process_id=rank)
             t = self._deadline.timeout_s

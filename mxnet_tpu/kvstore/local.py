@@ -82,6 +82,9 @@ class KVStoreLocal(KVStoreBase):
     def _reduce_rowsparse(values):
         import numpy as np
         import jax.numpy as jnp
+        # graftcheck: ignore[GC01] — sparse merge is host-side by design:
+        # np.unique over row indices has no jit-traceable analog, and
+        # _fusable() keeps sparse values off the fused/dense hot path
         idx = np.concatenate([np.asarray(v.indices._data) for v in values])
         dat = jnp.concatenate([v.data._data for v in values], axis=0)
         uniq, inv = np.unique(idx, return_inverse=True)

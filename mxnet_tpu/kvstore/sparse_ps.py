@@ -58,6 +58,19 @@ class SparsePS:
         self._optimizer = None
         self._updaters = {}
         self._state_tree = {}  # key -> structure template (see _tree_of)
+        # service-wide lock guarding the shared optimizer/updater/state
+        # maps (per-table data rides each _Table's own lock).  Acquisition
+        # order is ALWAYS self._lock -> tbl.lock; found by graftcheck GC04:
+        # set_optimizer used to reset these maps lock-free while push
+        # installed updaters under a table lock, so a concurrent push
+        # could resurrect a stale-optimizer updater after the reset.
+        # _gen bumps on every optimizer swap: push snapshots (gen,
+        # optimizer, updater) under _lock, runs the heavy per-table update
+        # under tbl.lock ONLY (pushes to different tables stay concurrent),
+        # and restarts if the generation moved in between — a stale
+        # updater can never write state past a reset.
+        self._lock = threading.Lock()
+        self._gen = 0
 
     # -- registration -------------------------------------------------------
     def init(self, key, value):
@@ -80,13 +93,20 @@ class SparsePS:
         """Server-side optimizer (reference kvstore.set_optimizer →
         server runs the updater).  Switching optimizers resets ALL
         per-row state (stale momenta must not feed the new update rule)."""
-        self._optimizer = optimizer
-        self._updaters = {}
-        self._state_tree = {}
-        for tbl in self._tables.values():
-            with tbl.lock:
-                tbl.state_leaves = None
-                tbl.state_inited = None
+        with self._lock:
+            self._gen += 1
+            self._optimizer = optimizer
+            self._updaters = {}
+            self._state_tree = {}
+            for tbl in self._tables.values():
+                with tbl.lock:
+                    tbl.state_leaves = None
+                    tbl.state_inited = None
+            # the per-table loop just synchronized with every in-flight
+            # old-generation push (each holds its tbl.lock until done) —
+            # any _state_tree entry such a push wrote between our clear
+            # above and its table's clear is wiped here, totally
+            self._state_tree = {}
 
     # -- traffic ------------------------------------------------------------
     def push(self, key, grad):
@@ -110,30 +130,44 @@ class SparsePS:
         uniq, inv = _np.unique(rows, return_inverse=True)
         merged = _np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
         _np.add.at(merged, inv, vals)
-        with tbl.lock:
-            if self._optimizer is None:
-                tbl.value[uniq] += merged  # raw accumulate (no updater)
+        while True:
+            with self._lock:
+                gen = self._gen
+                optimizer = self._optimizer
+                upd = self._updaters.get(key)
+                if optimizer is not None and upd is None:
+                    upd = opt.get_updater(optimizer)
+                    self._updaters[key] = upd
+            with tbl.lock:
+                if gen != self._gen:
+                    continue  # optimizer swapped between the locks —
+                    # re-snapshot so no stale updater writes fresh state
+                if optimizer is None:
+                    tbl.value[uniq] += merged  # raw accumulate (no updater)
+                    return
+                w = nd.array(tbl.value[uniq])
+                g = nd.array(merged)
+                self._ensure_states(tbl, key, uniq, w, optimizer)
+                upd.states[key] = self._gather_states(tbl, key, uniq)
+                upd(key, g, w)
+                self._scatter_states(tbl, key, uniq, upd.states[key])
+                tbl.value[uniq] = w.asnumpy()
                 return
-            upd = self._updaters.get(key)
-            if upd is None:
-                upd = opt.get_updater(self._optimizer)
-                self._updaters[key] = upd
-            w = nd.array(tbl.value[uniq])
-            g = nd.array(merged)
-            self._ensure_states(tbl, key, uniq, w)
-            upd.states[key] = self._gather_states(tbl, key, uniq)
-            upd(key, g, w)
-            self._scatter_states(tbl, key, uniq, upd.states[key])
-            tbl.value[uniq] = w.asnumpy()
 
     # -- per-row optimizer state (dense host arrays, vectorized IO) ---------
-    def _ensure_states(self, tbl, key, rows, w_block):
+    def _ensure_states(self, tbl, key, rows, w_block, optimizer):
         """Allocate dense state arrays once; state-init first-touch rows by
-        running create_state on their CURRENT values."""
+        running create_state on their CURRENT values.  ``optimizer`` is the
+        caller's generation snapshot — reading self._optimizer here could
+        see a mid-push swap."""
         from .. import ndarray as nd
         if key not in self._state_tree:
-            proto = self._optimizer.create_state_multi_precision(
+            proto = optimizer.create_state_multi_precision(
                 key, w_block[:1])
+            # graftcheck: ignore[GC04] — caller (push) holds tbl.lock and
+            # the generation check; set_optimizer re-clears this map after
+            # synchronizing on every table lock, so a stale write here
+            # cannot survive an optimizer swap
             self._state_tree[key] = _tree_of(proto)
             leaves = _leaves_of(proto)
             n_rows = tbl.value.shape[0]
@@ -143,7 +177,7 @@ class SparsePS:
             tbl.state_inited = _np.zeros(n_rows, bool)
         fresh = rows[~tbl.state_inited[rows]]
         if fresh.size:
-            init_state = self._optimizer.create_state_multi_precision(
+            init_state = optimizer.create_state_multi_precision(
                 key, nd.array(tbl.value[fresh]))
             for dst, lf in zip(tbl.state_leaves, _leaves_of(init_state)):
                 dst[fresh] = lf.asnumpy()

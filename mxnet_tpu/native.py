@@ -22,6 +22,8 @@ import threading
 
 import numpy as _np
 
+from . import config
+
 __all__ = ["recordio_lib", "native_available", "index_recordio",
            "read_recordio_batch"]
 
@@ -42,12 +44,14 @@ _ERRORS = {
 }
 
 
+def _cache_dir():
+    return config.get("MXNET_NATIVE_CACHE") \
+        or os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu")
+
+
 def _so_candidates():
     yield os.path.join(os.path.dirname(_SRC), "librecordio.so")
-    cache = os.environ.get(
-        "MXNET_NATIVE_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu"))
-    yield os.path.join(cache, "librecordio.so")
+    yield os.path.join(_cache_dir(), "librecordio.so")
 
 
 def _compile(out_path, src=_SRC, extra_link=()):
@@ -105,7 +109,7 @@ def recordio_lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("MXNET_USE_NATIVE", "1") == "0":
+        if not config.get_int("MXNET_USE_NATIVE", 1):
             return None
         for cand in _so_candidates():
             try:
@@ -211,10 +215,7 @@ _jpeg_tried = False
 
 def _jpeg_so_candidates():
     yield os.path.join(os.path.dirname(_JPEG_SRC), "libjpegdec.so")
-    cache = os.environ.get(
-        "MXNET_NATIVE_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu"))
-    yield os.path.join(cache, "libjpegdec.so")
+    yield os.path.join(_cache_dir(), "libjpegdec.so")
 
 
 def _bind_jpeg(path):
@@ -241,7 +242,7 @@ def jpeg_lib():
         if _jpeg_lib is not None or _jpeg_tried:
             return _jpeg_lib
         _jpeg_tried = True
-        if os.environ.get("MXNET_USE_NATIVE", "1") == "0":
+        if not config.get_int("MXNET_USE_NATIVE", 1):
             return None
         for cand in _jpeg_so_candidates():
             try:

@@ -13,11 +13,10 @@ while bf16/fp16 weights are updated from them (mp_* parity).
 
 from __future__ import annotations
 
-import os
-
 import numpy as _np
 
 from .base import MXNetError
+from . import config
 from . import ndarray as nd
 from .ndarray.ndarray import NDArray
 
@@ -154,8 +153,8 @@ class SGD(Optimizer):
         # reference optimizer.py: SGD aggregates up to
         # MXNET_OPTIMIZER_AGGREGATION_SIZE params per fused kernel call
         # (default 4) — the multi_sgd_update family
-        kwargs.setdefault("aggregate_num", int(os.environ.get(
-            "MXNET_OPTIMIZER_AGGREGATION_SIZE", "4")))
+        kwargs.setdefault("aggregate_num", config.get_int(
+            "MXNET_OPTIMIZER_AGGREGATION_SIZE", 4))
         super().__init__(**kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update

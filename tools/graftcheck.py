@@ -1,0 +1,49 @@
+#!/usr/bin/env python3
+"""graftcheck CLI — repo-native static analysis, importable without the
+mxnet_tpu runtime.
+
+CI runs this lane before any dependency install, so this launcher loads
+``mxnet_tpu/analysis/{core,passes}.py`` as a standalone package instead
+of importing ``mxnet_tpu`` (whose __init__ pulls in jax).  With the
+runtime available, ``python -m mxnet_tpu.analysis.core`` paths work too.
+
+    python tools/graftcheck.py                 # scan mxnet_tpu/
+    python tools/graftcheck.py mxnet_tpu/ --json
+    python tools/graftcheck.py --list-rules
+    python tools/graftcheck.py --write-baseline graftcheck-baseline.json
+    python tools/graftcheck.py --baseline graftcheck-baseline.json
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+import importlib
+import os
+import sys
+import types
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ANALYSIS_DIR = os.path.join(REPO_ROOT, "mxnet_tpu", "analysis")
+
+
+def _load_analysis():
+    """Load the analysis package under a private name so ``mxnet_tpu``'s
+    package __init__ (which imports jax) never runs."""
+    if "mxnet_tpu.analysis" in sys.modules:
+        return sys.modules["mxnet_tpu.analysis"]
+    pkg_name = "_graftcheck_analysis"
+    pkg = sys.modules.get(pkg_name)
+    if pkg is None:
+        pkg = types.ModuleType(pkg_name)
+        pkg.__path__ = [_ANALYSIS_DIR]
+        sys.modules[pkg_name] = pkg
+    importlib.import_module(pkg_name + ".passes")  # registers GC01–GC05
+    return importlib.import_module(pkg_name + ".core")
+
+
+def main(argv=None):
+    core = _load_analysis()
+    return core.main(argv, repo_root=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
