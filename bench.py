@@ -1,6 +1,9 @@
 """Driver benchmark: flagship BERT-base training-step throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — always
+the LAST stdout line.  Per-lane progress/error rows ({"lane", "status",
+...}) stream out (flushed) as each lane finishes, so a bench killed from
+outside still leaves a partial evidence trail.
 
 The measured config mirrors BASELINE's north star (BERT-base pretrain):
 batch x seq MLM step — forward + backward + Adam, fused into a single XLA
@@ -34,6 +37,11 @@ Env knobs:
   MXNET_BENCH_HEADLINE_TIMEOUT  wall-clock cap (s, default 2100) on the
                           headline child process — a hung tunnel records
                           an error row instead of wedging the bench
+  MXNET_BENCH_TOTAL_BUDGET_S  hard cap (s, default 3300) on the WHOLE
+                          orchestration: lane timeouts shrink to the
+                          remaining budget and lanes that no longer fit
+                          are skipped with an error row, keeping total
+                          wall below the driver's own kill timeout
   MXNET_BENCH_CHILD       internal: set by the parent shell; children
                           measure, the parent orchestrates
 """
@@ -391,17 +399,73 @@ def _orchestrate(name):
     the flash path is O(L) in memory, the BASELINE config-2 vision lane
     and the input-pipeline rate (VERDICT r4 weak #5).  Every lane is a
     SUBPROCESS with a hard timeout; failures record an error note instead
-    of zeroing or wedging the headline metric."""
+    of zeroing or wedging the headline metric.
+
+    Watchdog hardening (ISSUE 5 satellite — both r5 bench artifacts were
+    lost to a dead tunnel): every lane emits an incremental flushed
+    progress/error JSON row the moment it finishes, so a driver-level
+    kill (rc=124) still leaves partial rows on stdout; and the whole
+    orchestration runs under MXNET_BENCH_TOTAL_BUDGET_S (default 3300 s)
+    — lane timeouts shrink to the remaining budget and lanes that no
+    longer fit are skipped with an error row instead of overrunning.
+    The LAST stdout line remains the single combined result (the driver
+    contract)."""
     llama_lane, vision = _bench_kind(name)
+    t_start = time.monotonic()
+    budget = float(os.environ.get("MXNET_BENCH_TOTAL_BUDGET_S", "3300"))
+
+    def remaining():
+        return budget - (time.monotonic() - t_start)
+
+    def emit(row):
+        # incremental progress row: flushed immediately so a killed bench
+        # still leaves a partial trail instead of an empty tail
+        print(json.dumps(row), flush=True)
+
     timeout = int(os.environ.get("MXNET_BENCH_HEADLINE_TIMEOUT", "2100"))
+    timeout = max(60, min(timeout, int(remaining()) - 120))
     try:
         result = _lane_subprocess({}, timeout=timeout)
+        emit({"lane": "headline", "status": "ok",
+              "metric": result.get("metric"), "value": result.get("value"),
+              "vs_baseline": result.get("vs_baseline"),
+              "elapsed_s": round(time.monotonic() - t_start, 1)})
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
-        print(json.dumps(_error_result(name, vision, e)))
+        emit({"lane": "headline", "status": "error",
+              "error": f"{type(e).__name__}: {e}"[:200],
+              "elapsed_s": round(time.monotonic() - t_start, 1)})
+        print(json.dumps(_error_result(name, vision, e)), flush=True)
         return 1
     if os.environ.get("MXNET_BENCH_LANES", "all") == "all" and not vision:
         lanes = []
+
+        def run_lane(label, fn, cap):
+            lane_cap = int(min(cap, remaining() - 60))
+            if lane_cap < 60:
+                row = {"lane": label,
+                       "error": "skipped: MXNET_BENCH_TOTAL_BUDGET_S "
+                                "exhausted"}
+                lanes.append(row)
+                emit({**row, "status": "skipped",
+                      "elapsed_s": round(time.monotonic() - t_start, 1)})
+                return
+            try:
+                r = fn(lane_cap)
+                r["lane"] = label
+                lanes.append(r)
+                emit({"lane": label, "status": "ok",
+                      "metric": r.get("metric"), "value": r.get("value"),
+                      "vs_baseline": r.get("vs_baseline"),
+                      "elapsed_s": round(time.monotonic() - t_start, 1)})
+            except Exception as e:  # noqa: BLE001
+                traceback.print_exc(file=sys.stderr)
+                row = {"lane": label,
+                       "error": f"{type(e).__name__}: {e}"[:200]}
+                lanes.append(row)
+                emit({**row, "status": "error",
+                      "elapsed_s": round(time.monotonic() - t_start, 1)})
+
         for label, envs in [
             ("bert_seq512", {"MXNET_BENCH_SEQLEN": "512",
                              "MXNET_BENCH_BATCH": "32",
@@ -414,22 +478,12 @@ def _orchestrate(name):
                           "MXNET_BENCH_BATCH": "64",
                           "MXNET_BENCH_SCAN_STEPS": "32"}),
         ]:
-            try:
-                r = _lane_subprocess(envs)
-                r["lane"] = label
-                lanes.append(r)
-            except Exception as e:  # noqa: BLE001
-                traceback.print_exc(file=sys.stderr)
-                lanes.append({"lane": label,
-                              "error": f"{type(e).__name__}: {e}"[:200]})
-        try:
-            r = _io_bench_subprocess()
-            r["lane"] = "io_pipeline"
-            lanes.append(r)
-        except Exception as e:  # noqa: BLE001
-            traceback.print_exc(file=sys.stderr)
-            lanes.append({"lane": "io_pipeline",
-                          "error": f"{type(e).__name__}: {e}"[:200]})
+            run_lane(label,
+                     lambda cap, _envs=envs: _lane_subprocess(_envs,
+                                                              timeout=cap),
+                     1500)
+        run_lane("io_pipeline",
+                 lambda cap: _io_bench_subprocess(timeout=cap), 900)
         result["extra"]["lanes"] = lanes
 
     print(json.dumps(result))

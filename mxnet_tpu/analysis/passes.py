@@ -47,9 +47,12 @@ HOT_PATHS = {
     "kvstore/fusion.py": None,
     "kvstore/local.py": {"_reduce", "_reduce_rowsparse", "_store_merged",
                          "push", "pull", "pushpull", "pushpull_list",
-                         "_fused_pushpull"},
+                         "_fused_pushpull", "pushpull_flat",
+                         "_split_fusable", "_stage_bucket"},
     "gluon/trainer.py": {"step", "_allreduce_grads", "_update",
-                         "_update_impl", "_update_aggregated"},
+                         "_update_impl", "_update_aggregated",
+                         "_update_fused", "_fused_kind"},
+    "optimizer_fusion.py": None,
 }
 
 # GC05 additionally audits these (they sit on the per-batch/per-call path

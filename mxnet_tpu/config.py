@@ -140,7 +140,20 @@ KNOWN_VARS = {
     "MXNET_OPTIMIZER_AGGREGATION_SIZE": (
         "4", int,
         "Max same-dtype params fused into one multi-tensor optimizer "
-        "dispatch (multi_sgd_update family); 1 disables aggregation."),
+        "dispatch (multi_sgd_update family); 1 disables aggregation. "
+        "Only reached when MXNET_OPTIMIZER_FUSED=0."),
+    # flat-buffer fused optimizer (ISSUE 5: optimizer_fusion)
+    "MXNET_OPTIMIZER_FUSED": (
+        "1", int,
+        "If 1 (default), adam/sgd updates run as ONE donated jitted "
+        "dispatch per dtype bucket over persistent flat state buffers "
+        "(optimizer_fusion; bitwise identical to the per-param path); "
+        "0 restores per-param updates everywhere."),
+    "MXNET_OPTIMIZER_BUCKET_MB": (
+        "25", float,
+        "Fused-optimizer bucket size bound (MB): same-dtype parameters "
+        "group into flat-state buckets of at most this many bytes, one "
+        "donated update dispatch each. <= 0 disables optimizer fusion."),
     # native (C++) fast lanes
     "MXNET_USE_NATIVE": (
         "1", int,

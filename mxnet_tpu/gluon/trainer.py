@@ -31,6 +31,7 @@ from __future__ import annotations
 from ..base import MXNetError
 from .. import optimizer as opt
 from .. import telemetry as _tel
+from ..telemetry import tracer as _ttrace
 from ..resilience import chaos as _chaos
 from .parameter import ParameterDict, Parameter
 
@@ -68,6 +69,10 @@ class Trainer:
         # optimizer is best on device (documented divergence for dist: no
         # server role exists), so default False unless explicitly requested
         self._update_on_kvstore = bool(update_on_kvstore)
+        # flat reduced-gradient buckets handed from the kvstore's fused
+        # allreduce straight to the fused optimizer (optimizer_fusion):
+        # [(key_list, shapes, sizes, flat_array)] stashed per step
+        self._flat_handoff = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -175,7 +180,7 @@ class Trainer:
                     self._scale = base_scale
                     return  # skip step; dynamic scaler backed off
             self._optimizer.rescale_grad = scale
-            self._allreduce_grads()
+            self._allreduce_grads(allow_flat=True)
             if not self._update_on_kvstore:
                 self._update(ignore_stale_grad)
             if scaler is not None:
@@ -192,7 +197,11 @@ class Trainer:
                 "(reference contract)")
         self._allreduce_grads()
 
-    def _allreduce_grads(self):
+    def _allreduce_grads(self, allow_flat=False):
+        # allow_flat only inside step(): the public allreduce_grads()
+        # contract is "reduced grads land in the grad buffers", which the
+        # flat handoff deliberately skips
+        self._flat_handoff = None
         if self._kvstore is None:
             return
         with _tel.span("trainer.allreduce", "trainer",
@@ -224,6 +233,16 @@ class Trainer:
                 vals.append(grads if len(grads) > 1 else grads[0])
             if not keys:
                 return
+            if allow_flat and self._fused_kind() is not None \
+                    and hasattr(self._kvstore, "pushpull_flat"):
+                # fused-optimizer handoff: reduced buckets stay FLAT and
+                # feed the donated optimizer update directly (no
+                # unflatten/reflatten HBM round trip).  Bucketed keys'
+                # grad buffers keep their local pre-reduction values.
+                res = self._kvstore.pushpull_flat(keys, vals, vals)
+                if res is not None:
+                    self._flat_handoff = res
+                    return
             if hasattr(self._kvstore, "pushpull_list"):
                 self._kvstore.pushpull_list(keys, vals, vals)
             else:  # duck-typed store: reference per-key push+pull
@@ -244,8 +263,24 @@ class Trainer:
         with _tel.span("trainer.optimizer", "trainer"):
             self._update_impl()
 
+    def _fused_kind(self):
+        """'adam'/'sgd' when the flat-buffer fused optimizer path applies
+        to this step, else None (knob off, unsupported optimizer, or the
+        kvstore owns the update)."""
+        if self._update_on_kvstore:
+            return None
+        from .. import optimizer_fusion as _fus
+        if not _fus.fusion_active(self._optimizer):
+            return None
+        return _fus.supported_kind(self._optimizer)
+
     def _update_impl(self):
         optzr = self._optimizer
+        # a stashed flat handoff MUST be consumed fused — its keys' grad
+        # buffers were deliberately left unreduced
+        if self._flat_handoff is not None or self._fused_kind() is not None:
+            self._update_fused()
+            return
         agg = getattr(optzr, "aggregate_num", 0)
         if agg > 1 and len(self._updaters) == 1 \
                 and hasattr(optzr, "update_multi"):
@@ -269,6 +304,65 @@ class Trainer:
                         optzr._index_update_count[i] = snap_count
                     optzr.num_update = snap_num
                 upd(i, g, w)
+
+    def _update_fused(self):
+        """Flat-buffer fused optimizer step (optimizer_fusion): dense
+        params update in ONE donated jitted dispatch per dtype bucket —
+        fed flat reduced-gradient buffers directly when the kvstore's
+        fused allreduce handed them over — while sparse/row-sparse params
+        keep the per-key path, exactly like the kvstore fused fallback
+        rules.  Multi-replica: every replica applies the same update with
+        its own updater's states (step count t advances once)."""
+        from .. import optimizer_fusion as _fus
+        optzr = self._optimizer
+        handoff, self._flat_handoff = self._flat_handoff, None
+        dense, perkey = [], []
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            if p.stype == "default" and p.grad_stype == "default":
+                dense.append(i)
+            else:
+                perkey.append(i)
+        enabled = _ttrace._ENABLED
+        if perkey and enabled:
+            _fus.record_fallback(len(perkey))
+        covered = set()
+        for keys_list, _shapes, _sizes, _flat in (handoff or ()):
+            covered.update(keys_list)
+        rest = [i for i in dense if i not in covered]
+        datas = {i: self._params[i].list_data() for i in dense + perkey}
+        grads = {i: self._params[i].list_grad() for i in rest + perkey}
+        # replicas must see the SAME step count t: snapshot the shared
+        # optimizer's counters before the first replica and restore for
+        # each subsequent one (the fused analog of _update_impl's
+        # per-index snapshotting)
+        snap_counts = dict(optzr._index_update_count)
+        snap_num = optzr.num_update
+        for j, upd in enumerate(self._updaters):
+            if j > 0:
+                optzr._index_update_count.clear()
+                optzr._index_update_count.update(snap_counts)
+                optzr.num_update = snap_num
+            for keys_list, shapes, sizes, flat in (handoff or ()):
+                ks = [i for i in keys_list if j < len(datas[i])]
+                if len(ks) != len(keys_list):
+                    if not ks:
+                        continue
+                    raise MXNetError(
+                        "fused flat handoff spans params with unequal "
+                        "replica counts; use MXNET_OPTIMIZER_FUSED=0")
+                upd.call_fused(ks, None, [datas[i][j] for i in ks],
+                               flat_grad=flat, shapes=shapes, sizes=sizes)
+            rj = [i for i in rest if j < len(datas[i])]
+            if rj:
+                upd.call_fused(rj, [grads[i][j] for i in rj],
+                               [datas[i][j] for i in rj])
+            if enabled and (handoff or rj):
+                _fus.record_update()   # one per replica step, not per call
+            for i in perkey:
+                if j < len(datas[i]):
+                    upd(i, grads[i][j], datas[i][j])
 
     def _update_aggregated(self, agg):
         """Multi-tensor fast path (reference optimizer aggregation over

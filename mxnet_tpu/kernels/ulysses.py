@@ -44,7 +44,10 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=1.0):
     (unscaled — the caller applies 1/√d).  The head dim H must divide by
     the axis size n (standard Ulysses requirement — heads are what gets
     scattered)."""
-    n = jax.lax.axis_size(axis_name)
+    # jax.lax.axis_size doesn't exist on this toolchain (jax 0.4.x);
+    # psum over the literal 1 folds to the static axis size — the same
+    # idiom ring_attention.py uses
+    n = jax.lax.psum(1, axis_name)
     B, H, Lb, D = q.shape
     if H % n:
         raise ValueError(f"ulysses: heads {H} not divisible by axis {n}")

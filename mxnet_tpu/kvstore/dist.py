@@ -401,6 +401,12 @@ class KVStoreDistTPUSync(KVStoreLocal):
         self._ensure_dist()
         return super().pushpull_list(keys, values, outs, priority=priority)
 
+    def pushpull_flat(self, keys, values, outs, priority=0):
+        # flat handoff to the fused optimizer: the bucket crosses
+        # processes as ONE psum (_allreduce_flat) and is consumed flat
+        self._ensure_dist()
+        return super().pushpull_flat(keys, values, outs, priority=priority)
+
     def _gather_packed(self, packed):
         """(nbytes,) uint8 local codes → (P, nbytes) from every process."""
         import jax.numpy as jnp

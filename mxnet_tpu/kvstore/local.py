@@ -217,18 +217,44 @@ class KVStoreLocal(KVStoreBase):
         instead."""
         return False
 
-    def pushpull_list(self, keys, values, outs, priority=0):
-        if self._updater is not None or self._bucket_bytes <= 0:
-            # update-on-kvstore runs the optimizer inside push — the fused
-            # path has no update hook, so take the per-key loop verbatim
-            return KVStoreBase.pushpull_list(self, keys, values, outs,
-                                             priority=priority)
+    def _split_fusable(self, keys, values):
+        """Classify keys into fused-eligible vs per-key fallback positions
+        (shared by pushpull_list and pushpull_flat so the fallback
+        contract cannot diverge between the two entry points)."""
         fused, fallback, vlists = [], [], []
         for j, key in enumerate(keys):
             v = values[j]
             vlist = list(v) if _is_list(v) else [v]
             vlists.append(vlist)
             (fused if self._fusable(key, vlist) else fallback).append(j)
+        return fused, fallback, vlists
+
+    @staticmethod
+    def _stage_bucket(bucket, vlists):
+        """One bucket's replica-major raw arrays, staged onto the primary
+        replica's device; returns (arrays, prim_ctx)."""
+        import jax
+        prim_ctx = vlists[bucket.positions[0]][0].ctx
+        prim_dev = None  # resolved lazily; staging is rare
+        arrays = []
+        for r in range(bucket.n_rep):
+            for p in bucket.positions:
+                v = vlists[p][r]
+                a = v._data
+                if v.ctx != prim_ctx:
+                    if prim_dev is None:
+                        prim_dev = prim_ctx.jax_device()
+                    a = jax.device_put(a, prim_dev)
+                arrays.append(a)
+        return arrays, prim_ctx
+
+    def pushpull_list(self, keys, values, outs, priority=0):
+        if self._updater is not None or self._bucket_bytes <= 0:
+            # update-on-kvstore runs the optimizer inside push — the fused
+            # path has no update hook, so take the per-key loop verbatim
+            return KVStoreBase.pushpull_list(self, keys, values, outs,
+                                             priority=priority)
+        fused, fallback, vlists = self._split_fusable(keys, values)
         for j in fallback:
             self.pushpull(keys[j], values[j], out=outs[j], priority=priority)
         if _ttrace._ENABLED:
@@ -253,18 +279,7 @@ class KVStoreLocal(KVStoreBase):
             for b in buckets:
                 t0 = _time.perf_counter_ns() if enabled else 0
                 try:
-                    prim_ctx = vlists[b.positions[0]][0].ctx
-                    prim_dev = None  # resolved lazily; staging is rare
-                    arrays = []
-                    for r in range(b.n_rep):
-                        for p in b.positions:
-                            v = vlists[p][r]
-                            a = v._data
-                            if v.ctx != prim_ctx:
-                                if prim_dev is None:
-                                    prim_dev = prim_ctx.jax_device()
-                                a = jax.device_put(a, prim_dev)
-                            arrays.append(a)
+                    arrays, prim_ctx = self._stage_bucket(b, vlists)
                     if needs_flat:
                         # wire strategy: one flat buffer → ONE collective
                         flat = bucketer.reduce_flat(b, arrays)
@@ -308,6 +323,61 @@ class KVStoreLocal(KVStoreBase):
                 fusion.record_pushpull()
                 span_.set(keys=len(keys), buckets=len(buckets),
                           bytes=total_bytes)
+
+    def pushpull_flat(self, keys, values, outs, priority=0):
+        """Fused allreduce returning FLAT per-bucket reduced-gradient
+        buffers for direct consumption by the fused optimizer
+        (optimizer_fusion.fused_update_flat): bucketed keys reduce flat —
+        one collective per bucket on the dist wire — and are NOT
+        unflattened; neither the store copies nor ``outs`` are written
+        for them (their grad buffers keep local pre-reduction values;
+        that skipped round trip is the point).  Non-fusable keys take the
+        per-key pushpull into ``outs`` exactly like pushpull_list.
+
+        Returns ``[(key_list, shapes, sizes, flat_array), ...]``, or
+        None — fall back to pushpull_list — when fusion is off, the
+        store owns the update, or no cross-process wire step exists
+        (``_fused_needs_flat``): in-process the flat buffer is pure copy
+        overhead (per-key reduction + per-param fused update is strictly
+        cheaper), so the handoff only engages where the flat buffer has
+        to exist anyway for the wire collective.  Failures propagate —
+        this path is multi-process by construction, and a per-key replay
+        while peers ran the collective would desync the global order
+        (same contract as _fused_pushpull's needs_flat branch)."""
+        if self._updater is not None or self._bucket_bytes <= 0 \
+                or not self._fused_needs_flat():
+            return None
+        fused, fallback, vlists = self._split_fusable(keys, values)
+        for j in fallback:
+            self.pushpull(keys[j], values[j], out=outs[j], priority=priority)
+        enabled = _ttrace._ENABLED
+        if enabled:
+            fusion.record_fallback(len(fallback))
+        if not fused:
+            return []
+        bucketer = self._bucketer
+        if bucketer is None:
+            bucketer = self._bucketer = fusion.GradBucketer(self._bucket_bytes)
+        fkeys = [keys[j] for j in fused]
+        fvlists = [vlists[j] for j in fused]
+        signature = tuple((tuple(v[0].shape), str(v[0].dtype), len(v))
+                          for v in fvlists)
+        buckets = bucketer.plan(signature)
+        result = []
+        with _tel.span("kvstore.fused_pushpull_flat", "kvstore") as span_:
+            for b in buckets:
+                t0 = _time.perf_counter_ns() if enabled else 0
+                arrays, _prim = self._stage_bucket(b, fvlists)
+                flat = bucketer.reduce_flat(b, arrays)
+                flat = self._allreduce_flat(flat)
+                result.append(([fkeys[p] for p in b.positions],
+                               b.shapes, b.sizes, flat))
+                if enabled:
+                    fusion.record_bucket(b, _time.perf_counter_ns() - t0)
+            if enabled:
+                fusion.record_pushpull()
+                span_.set(keys=len(fused), buckets=len(buckets))
+        return result
 
     def _fused_bucket_fallback(self, bucket, keys, vlists, outs):
         """Replay one failed fused bucket through the per-key path

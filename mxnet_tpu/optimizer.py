@@ -143,6 +143,20 @@ class Optimizer:
             kw["clip_gradient"] = self.clip_gradient
         return kw
 
+    def update_multi(self, indices, weights, grads, states):
+        """Fused N-param update: ONE donated jitted dispatch per dtype
+        bucket through mxnet_tpu.optimizer_fusion (flat-buffer multi-
+        tensor apply, bitwise identical to N update_multi_precision
+        calls).  Optimizers the fusion layer does not reproduce — and
+        every optimizer when ``MXNET_OPTIMIZER_FUSED=0`` — fall back to
+        the per-param loop."""
+        from . import optimizer_fusion as _fus
+        if _fus.fusion_active(self):
+            _fus.fused_update(self, indices, weights, grads, states)
+            return
+        for i, w, g, st in zip(indices, weights, grads, states):
+            self.update_multi_precision(i, w, g, st)
+
     def __repr__(self):
         return f"{type(self).__name__}(lr={self.learning_rate})"
 
@@ -174,9 +188,17 @@ class SGD(Optimizer):
                               momentum=self.momentum, **kw)
 
     def update_multi(self, indices, weights, grads, states):
-        """Fused N-param update — ONE dispatch via the multi_sgd_update /
-        multi_mp_sgd_* registry ops (reference optimizer_op.cc multi-
-        tensor kernels).  Numerics identical to N update() calls."""
+        """Fused N-param update — ONE dispatch via the flat-buffer donated
+        executables (optimizer_fusion) when MXNET_OPTIMIZER_FUSED is on,
+        else the multi_sgd_update / multi_mp_sgd_* registry ops
+        (reference optimizer_op.cc multi-tensor kernels).  Numerics
+        identical to N update() calls either way."""
+        from . import optimizer_fusion as _fus
+        if _fus.fusion_active(self):
+            # exact-SGD only: subclasses (and a disabled/zero-bucket knob)
+            # keep the legacy multi_sgd kernels below
+            _fus.fused_update(self, indices, weights, grads, states)
+            return
         for i in indices:
             self._update_count(i)
         # lr/wd vectors must live WITH the weights (a cpu-ctx vector next
@@ -513,6 +535,22 @@ class Updater:
         states = [self._ensure_state(i, w)
                   for i, w in zip(indices, weights)]
         self.optimizer.update_multi(indices, weights, grads, states)
+
+    def call_fused(self, indices, grads, weights, flat_grad=None,
+                   shapes=None, sizes=None):
+        """Flat-buffer fused step (optimizer_fusion): per-param grads plan
+        their own dtype buckets; a ``flat_grad`` buffer (one reduced
+        bucket straight off the kvstore wire, pushpull_flat) feeds the
+        donated update directly with the provided bucket layout."""
+        from . import optimizer_fusion as _fus
+        states = [self._ensure_state(i, w)
+                  for i, w in zip(indices, weights)]
+        if flat_grad is not None:
+            _fus.fused_update_flat(self.optimizer, indices, weights,
+                                   states, shapes, sizes, flat_grad)
+        else:
+            _fus.fused_update(self.optimizer, indices, weights, grads,
+                              states)
 
     def get_states(self, dump_optimizer=False):  # noqa: ARG002
         import pickle

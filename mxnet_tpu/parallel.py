@@ -355,6 +355,7 @@ class TrainStep:
         self._trainable = None
         self._states = None       # index -> optimizer state (NDArray tree)
         self._state_nds = None    # flattened state NDArrays
+        self._fused = None        # (kind, bucket plan) — optimizer_fusion
         self._cache = {}
         self._cache_epoch = None
         self._step_count = 0
@@ -391,6 +392,13 @@ class TrainStep:
         for i in range(len(self._trainable)):
             self._flat_state(self._states[i], flat)
         self._state_nds = flat
+        # fused optimizer (optimizer_fusion): plan the dtype buckets NOW
+        # (host side, before any tracing); raw() then updates through the
+        # fused math inline — the same formulas the imperative Trainer
+        # path dispatches with donation — instead of tracing ~2 registry
+        # dispatch wrappers per parameter
+        from . import optimizer_fusion as _fus
+        self._fused = _fus.plan_trainstep(self.optimizer, self._trainable)
 
     def _param_sharding(self, p):
         if p.sharding:
@@ -414,6 +422,8 @@ class TrainStep:
         optzr = self.optimizer
         loss_fn = self.loss_fn
         net = self.net
+        fused = self._fused
+        from . import optimizer_fusion as _fus
 
         from .ndarray.ndarray import swap_slot_values
 
@@ -444,10 +454,17 @@ class TrainStep:
                         if loss.shape:
                             loss = loss.mean()
                     autograd.backward([loss])
-                    for i, p in enumerate(trainable):
-                        optzr.update_multi_precision(i, p._data,
-                                                     p._data._grad,
-                                                     self._states[i])
+                    if fused is not None:
+                        # fused flat update: same segment math as the
+                        # imperative donated executables, inlined into
+                        # this trace (bitwise identical to the loop below)
+                        _fus.traced_update(optzr, fused[0], fused[1],
+                                           trainable, self._states)
+                    else:
+                        for i, p in enumerate(trainable):
+                            optzr.update_multi_precision(i, p._data,
+                                                         p._data._grad,
+                                                         self._states[i])
                     new_p = tuple(p._data._slot.value for p in params)
                     new_s = tuple(s._slot.value for s in state_nds)
                     return new_p, new_s, loss._data

@@ -91,9 +91,11 @@ def _train(agg, steps=3, n_layers=6, seed=5):
             for k, v in net.collect_params().items()}
 
 
-def test_trainer_aggregated_matches_per_param():
+def test_trainer_aggregated_matches_per_param(monkeypatch):
     """aggregate_num>1 routes through multi_sgd_mom_update groups; params
-    after 3 steps match the per-param path bit-for-bit in formula."""
+    after 3 steps match the per-param path bit-for-bit in formula.
+    (Flat-buffer fusion off: it supersedes aggregation when enabled.)"""
+    monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "0")
     base = _train(agg=0)
     fused = _train(agg=4)
     assert base.keys() == fused.keys()
@@ -102,10 +104,13 @@ def test_trainer_aggregated_matches_per_param():
                                    err_msg=k)
 
 
-def test_aggregation_reduces_dispatch_count():
+def test_aggregation_reduces_dispatch_count(monkeypatch):
     """The point of the multi-tensor path: fewer host dispatches per step
     (reference: one multi_sgd kernel per aggregate group).  Counted via
-    the profiler's dispatch ledger."""
+    the profiler's dispatch ledger.  Runs with the flat-buffer fused
+    optimizer OFF — it supersedes aggregation when enabled (ISSUE 5;
+    tests/test_optimizer_fusion.py covers that path)."""
+    monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "0")
 
     def count_update_dispatches(agg):
         mx.random.seed(1)

@@ -1,0 +1,578 @@
+"""Flat-buffer fused optimizer tests (ISSUE 5: optimizer_fusion).
+
+The contract under test: with MXNET_OPTIMIZER_FUSED=1 (the default),
+adam/sgd updates run as ONE donated jitted dispatch per dtype bucket and
+are **bitwise identical** to the per-param path — across optimizers,
+multi-precision, mixed dtypes, multi-replica, per-param lr/wd
+multipliers, checkpoint resume, the kvstore flat-gradient handoff, and
+TrainStep's traced update — with per-key fallback for sparse params and
+loss-scale overflow skips, zero steady-state retraces, and a dispatch
+count equal to the bucket count.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, optimizer_fusion as fus
+from mxnet_tpu.gluon import utils as gutils
+
+
+@pytest.fixture(autouse=True)
+def _reset_fusion(monkeypatch):
+    """Every test starts from the default knobs and a clean plan cache."""
+    monkeypatch.delenv("MXNET_OPTIMIZER_FUSED", raising=False)
+    monkeypatch.delenv("MXNET_OPTIMIZER_BUCKET_MB", raising=False)
+    fus.reset()
+    yield
+    fus.reset()
+
+
+def _mlp(n_layers=4, units=16, seed=7, dtype=None):
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        for _ in range(n_layers):
+            net.add(gluon.nn.Dense(units, activation="relu", in_units=units))
+    net.initialize(mx.initializer.Xavier())
+    if dtype is not None:
+        net.cast(dtype)
+    return net
+
+
+def _params_np(net):
+    return {k.split("_", 1)[-1]: v.data().asnumpy()
+            for k, v in net.collect_params().items()}
+
+
+def _train(fused, opt_name, opt_kw, steps=6, dtype=None, mp=False,
+           lr_mult=False, monkeypatch=None, net_fn=_mlp):
+    monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1" if fused else "0")
+    fus.reset()
+    net = net_fn(dtype=dtype)
+    kw = dict(opt_kw)
+    kw["multi_precision"] = mp
+    if not fused:
+        kw["aggregate_num"] = 1    # true per-param baseline
+    tr = gluon.Trainer(net.collect_params(), opt_name, kw)
+    if lr_mult:
+        for k, p in net.collect_params().items():
+            p.lr_mult = 0.5 if k.endswith("bias") else 1.5
+            p.wd_mult = 0.0 if k.endswith("bias") else 2.0
+    lf = gluon.loss.L2Loss()
+    r = np.random.RandomState(3)
+    x = mx.nd.array(r.randn(4, 16).astype(np.float32))
+    y = mx.nd.array(r.randn(4, 16).astype(np.float32))
+    if dtype is not None:
+        x, y = x.astype(dtype), y.astype(dtype)
+    for _ in range(steps):
+        with autograd.record():
+            loss = lf(net(x), y)
+        loss.backward()
+        tr.step(4)
+    return _params_np(net), tr
+
+
+def _assert_bitwise(a, b, msg=""):
+    assert a.keys() == b.keys()
+    for k in a:
+        assert a[k].tobytes() == b[k].tobytes(), \
+            f"{msg} param {k}: max |d| = " \
+            f"{np.abs(a[k].astype(np.float64) - b[k].astype(np.float64)).max()}"
+
+
+CASES = [
+    ("adam", {"learning_rate": 1e-3, "wd": 0.01}, None, False, False),
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 0.01},
+     None, False, False),
+    ("sgd", {"learning_rate": 0.05}, None, False, False),
+    ("sgd", {"learning_rate": 0.05, "clip_gradient": 0.1}, None, False,
+     False),
+    ("adam", {"learning_rate": 1e-3, "wd": 0.01}, None, False, True),
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 0.01},
+     None, False, True),
+]
+
+
+@pytest.mark.parametrize("opt_name,kw,dtype,mp,lr_mult", CASES)
+def test_fused_bit_identical_to_per_param(opt_name, kw, dtype, mp, lr_mult,
+                                          monkeypatch):
+    a, _ = _train(False, opt_name, kw, dtype=dtype, mp=mp, lr_mult=lr_mult,
+                  monkeypatch=monkeypatch)
+    b, _ = _train(True, opt_name, kw, dtype=dtype, mp=mp, lr_mult=lr_mult,
+                  monkeypatch=monkeypatch)
+    _assert_bitwise(a, b, f"{opt_name} {kw}")
+
+
+MP_CASES = [
+    ("adam", {"learning_rate": 1e-2, "wd": 0.01}, True),
+    ("adam", {"learning_rate": 1e-2}, False),   # half states, no masters
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9}, True),
+    ("sgd", {"learning_rate": 0.05}, True),     # mp + stateless sgd
+]
+
+
+@pytest.mark.parametrize("opt_name,kw,mp", MP_CASES)
+def test_fused_bit_identical_bf16(opt_name, kw, mp, monkeypatch):
+    import ml_dtypes
+    a, _ = _train(False, opt_name, kw, dtype=ml_dtypes.bfloat16, mp=mp,
+                  monkeypatch=monkeypatch)
+    b, _ = _train(True, opt_name, kw, dtype=ml_dtypes.bfloat16, mp=mp,
+                  monkeypatch=monkeypatch)
+    _assert_bitwise(a, b, f"bf16 {opt_name} mp={mp}")
+
+
+def _mixed_net(dtype=None, seed=7):  # noqa: ARG001 — dtype fixed per layer
+    """Two dtypes in one net → two buckets per step (mixed-dtype case)."""
+    import ml_dtypes
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu", in_units=16))
+        half = gluon.nn.Dense(16, activation="relu", in_units=16)
+        net.add(half)
+        net.add(gluon.nn.Dense(16, in_units=16))
+    net.initialize(mx.initializer.Xavier())
+    half.cast(ml_dtypes.bfloat16)
+    return net
+
+
+def test_fused_bit_identical_mixed_dtypes(monkeypatch):
+    """bf16 + f32 params in one Trainer split into per-dtype buckets and
+    still match the per-param path bit-for-bit (mp masters for the half
+    bucket only)."""
+    kw = {"learning_rate": 1e-2, "wd": 0.01}
+    a, _ = _train(False, "adam", kw, mp=True, monkeypatch=monkeypatch,
+                  net_fn=_mixed_net)
+    b, tr = _train(True, "adam", kw, mp=True, monkeypatch=monkeypatch,
+                   net_fn=_mixed_net)
+    _assert_bitwise(a, b, "mixed dtypes")
+    sig = tuple((tuple(p.data().shape), str(p.data().dtype), 1)
+                for p in tr._params)
+    assert len(fus.planner().plan(sig)) == 2  # one bucket per dtype
+
+
+def test_fused_multi_replica_bit_identical(monkeypatch):
+    """2 device replicas through kvstore 'device': the fused path consumes
+    the flat reduced buckets straight off the fused allreduce
+    (pushpull_flat) and every replica's weights stay bit-identical to
+    the per-key path."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+
+    def run(fused):
+        monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1" if fused else "0")
+        fus.reset()
+        ctxs = [mx.cpu(0), mx.cpu(1)]
+        mx.random.seed(7)
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            for _ in range(3):
+                net.add(gluon.nn.Dense(16, activation="relu", in_units=16))
+        net.initialize(mx.initializer.Xavier(), ctx=ctxs)
+        kw = {"learning_rate": 1e-3, "wd": 0.01}
+        if not fused:
+            kw["aggregate_num"] = 1
+        tr = gluon.Trainer(net.collect_params(), "adam", kw,
+                           kvstore="device")
+        lf = gluon.loss.L2Loss()
+        r = np.random.RandomState(3)
+        X = mx.nd.array(r.randn(8, 16).astype(np.float32))
+        Y = mx.nd.array(r.randn(8, 16).astype(np.float32))
+        for _ in range(4):
+            xs = gutils.split_and_load(X, ctxs)
+            ys = gutils.split_and_load(Y, ctxs)
+            with autograd.record():
+                losses = [lf(net(x), y) for x, y in zip(xs, ys)]
+            autograd.backward(losses)
+            tr.step(8)
+        return {(k.split("_", 1)[-1], j): d.asnumpy()
+                for k, p in net.collect_params().items()
+                for j, d in enumerate(p.list_data())}
+
+    a, b = run(False), run(True)
+    assert a.keys() == b.keys()
+    for k in a:
+        assert a[k].tobytes() == b[k].tobytes(), k
+
+
+def test_flat_handoff_feeds_optimizer_directly(monkeypatch):
+    """When the store has a cross-process wire step (_fused_needs_flat —
+    simulated here on the local store, whose _allreduce_flat is the
+    identity) the reduced gradients stay FLAT end to end and feed the
+    fused optimizer directly, bitwise equal to the per-key path."""
+    from mxnet_tpu import telemetry
+
+    def run(fused, flat):
+        monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1" if fused else "0")
+        fus.reset()
+        net = _mlp()
+        kw = {"learning_rate": 1e-3, "wd": 0.01}
+        if not fused:
+            kw["aggregate_num"] = 1
+        kv = mx.kv.create("local")
+        if flat:
+            kv._fused_needs_flat = lambda: True  # the dist condition
+        tr = gluon.Trainer(net.collect_params(), "adam", kw, kvstore=kv)
+        lf = gluon.loss.L2Loss()
+        r = np.random.RandomState(3)
+        x = mx.nd.array(r.randn(4, 16).astype(np.float32))
+        y = mx.nd.array(r.randn(4, 16).astype(np.float32))
+        for _ in range(3):
+            with autograd.record():
+                loss = lf(net(x), y)
+            loss.backward()
+            tr.step(4)
+        return _params_np(net), tr
+
+    a, _ = run(False, False)
+    telemetry.enable()
+    try:
+        u0 = telemetry.counter("mxnet_optimizer_fused_updates_total").value
+        b, tr = run(True, True)
+        assert telemetry.counter(
+            "mxnet_optimizer_fused_updates_total").value - u0 == 3
+    finally:
+        telemetry.disable()
+    _assert_bitwise(a, b, "flat handoff")
+    assert tr._flat_handoff is None  # consumed, not leaked
+    # in-process stores skip the handoff (flat buffer = pure copy
+    # overhead there): pushpull_flat declines and per-param fusion runs
+    c, _ = run(True, False)
+    _assert_bitwise(a, c, "in-process per-param fused")
+    kv = mx.kv.create("local")
+    assert kv.pushpull_flat([0], [mx.nd.ones((2,))],
+                            [mx.nd.ones((2,))]) is None
+
+
+def test_sparse_param_falls_back_per_key(monkeypatch):
+    """A row_sparse-grad embedding rides the per-key path while the dense
+    params stay fused — and the result matches per-param bitwise."""
+    def run(fused):
+        monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1" if fused else "0")
+        fus.reset()
+        mx.random.seed(7)
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            emb = gluon.nn.Embedding(12, 8, sparse_grad=True)
+            net.add(emb)
+            net.add(gluon.nn.Dense(8, flatten=False, in_units=8))
+        net.initialize(mx.initializer.Xavier())
+        kw = {"learning_rate": 0.05}
+        if not fused:
+            kw["aggregate_num"] = 1
+        tr = gluon.Trainer(net.collect_params(), "sgd", kw)
+        r = np.random.RandomState(5)
+        idx = mx.nd.array(r.randint(0, 12, (4, 3)).astype(np.float32))
+        y = mx.nd.array(r.randn(4, 3, 8).astype(np.float32))
+        lf = gluon.loss.L2Loss()
+        for _ in range(3):
+            with autograd.record():
+                loss = lf(net(idx), y)
+            loss.backward()
+            tr.step(4)
+        return _params_np(net)
+
+    a, b = run(False), run(True)
+    _assert_bitwise(a, b, "sparse fallback")
+
+
+def test_loss_scale_overflow_skips_fused_update(monkeypatch):
+    """amp dynamic-loss-scale overflow must skip the whole step (fused
+    path included) and back the scaler off — reference amp contract."""
+    from mxnet_tpu import amp
+    monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1")
+    amp.init(target_dtype="float16")
+    try:
+        net = gluon.nn.Dense(2)
+        net.initialize()
+        x = mx.nd.ones((2, 3))
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 0.5})
+        amp.init_trainer(tr)
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        w = list(net.collect_params().values())[0]
+        g = w.list_grad()[0]
+        g[:] = mx.nd.array(np.full(g.shape, np.inf, np.float32))
+        before = w.data().asnumpy().copy()
+        scale0 = tr._amp_loss_scaler.loss_scale
+        tr.step(1)
+        assert np.array_equal(w.data().asnumpy(), before)  # skipped
+        assert tr._amp_loss_scaler.loss_scale == scale0 / 2
+    finally:
+        amp.off()
+
+
+def test_update_on_kvstore_keeps_per_key_path(monkeypatch):
+    """update_on_kvstore owns the optimizer inside push — the fused layer
+    must stay out of the way entirely."""
+    monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1")
+    net = _mlp(n_layers=2)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05},
+                       kvstore="local", update_on_kvstore=True)
+    assert tr._fused_kind() is None
+    lf = gluon.loss.L2Loss()
+    r = np.random.RandomState(3)
+    x = mx.nd.array(r.randn(4, 16).astype(np.float32))
+    y = mx.nd.array(r.randn(4, 16).astype(np.float32))
+    before = _params_np(net)
+    with autograd.record():
+        loss = lf(net(x), y)
+    loss.backward()
+    tr.step(4)
+    after = _params_np(net)
+    assert any(not np.array_equal(before[k], after[k]) for k in before)
+
+
+def test_unsupported_optimizer_keeps_legacy_path(monkeypatch):
+    """Optimizers outside {Adam, SGD} (exact types) never enter the fused
+    layer — subclass math must not be silently replaced."""
+    monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1")
+    assert fus.supported_kind(mx.optimizer.Adam()) == "adam"
+    assert fus.supported_kind(mx.optimizer.SGD()) == "sgd"
+    assert fus.supported_kind(mx.optimizer.AdamW()) is None
+    assert fus.supported_kind(mx.optimizer.LARS()) is None
+    net = _mlp(n_layers=2)
+    tr = gluon.Trainer(net.collect_params(), "lamb", {"learning_rate": 1e-3})
+    assert tr._fused_kind() is None
+
+
+def test_sgd_subclass_keeps_legacy_update_multi(monkeypatch):
+    """Review regression: an SGD subclass inherits update_multi; the
+    fused gate must reject it (exact types only) and the legacy
+    multi_sgd aggregation path must carry the step instead of raising."""
+    monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1")
+
+    class MySGD(mx.optimizer.SGD):
+        pass
+
+    net = _mlp(n_layers=2)
+    tr = gluon.Trainer(net.collect_params(),
+                       MySGD(learning_rate=0.05, momentum=0.9))
+    assert tr._fused_kind() is None
+    lf = gluon.loss.L2Loss()
+    r = np.random.RandomState(3)
+    x = mx.nd.array(r.randn(4, 16).astype(np.float32))
+    y = mx.nd.array(r.randn(4, 16).astype(np.float32))
+    before = _params_np(net)
+    with autograd.record():
+        loss = lf(net(x), y)
+    loss.backward()
+    tr.step(4)   # aggregation path (aggregate_num default 4), no raise
+    after = _params_np(net)
+    assert any(not np.array_equal(before[k], after[k]) for k in before)
+
+
+def test_bucket_mb_zero_disables_every_entry(monkeypatch):
+    """Review regression: MXNET_OPTIMIZER_BUCKET_MB<=0 must disable
+    fusion through update_multi too, not only through Trainer's gate."""
+    from mxnet_tpu import telemetry
+    monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1")
+    monkeypatch.setenv("MXNET_OPTIMIZER_BUCKET_MB", "0")
+    assert not fus.fusion_active(mx.optimizer.SGD())
+    telemetry.enable()
+    try:
+        c0 = telemetry.counter("mxnet_optimizer_fused_buckets_total").value
+        opt = mx.optimizer.SGD(learning_rate=0.1)
+        r = np.random.RandomState(0)
+        ws = [nd.array(r.standard_normal((4,)).astype(np.float32))
+              for _ in range(2)]
+        gs = [nd.array(r.standard_normal((4,)).astype(np.float32))
+              for _ in range(2)]
+        opt.update_multi([0, 1], ws, gs, [None, None])
+        assert telemetry.counter(
+            "mxnet_optimizer_fused_buckets_total").value == c0
+    finally:
+        telemetry.disable()
+
+
+def test_bucket_mb_change_replans(monkeypatch):
+    """Review regression: flipping MXNET_OPTIMIZER_BUCKET_MB at runtime
+    must rebuild the planner (the on-chip sweep recipe relies on it)."""
+    monkeypatch.setenv("MXNET_OPTIMIZER_BUCKET_MB", "25")
+    sig = (((64, 64), "float32", 1),) * 4
+    assert len(fus.planner().plan(sig)) == 1
+    monkeypatch.setenv("MXNET_OPTIMIZER_BUCKET_MB", "0.017")  # ~1 tensor
+    assert len(fus.planner().plan(sig)) == 4
+
+
+def test_knob_off_restores_per_param(monkeypatch):
+    """MXNET_OPTIMIZER_FUSED=0 must leave zero fused telemetry behind."""
+    from mxnet_tpu import telemetry
+    monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "0")
+    telemetry.enable()
+    try:
+        c0 = telemetry.counter("mxnet_optimizer_fused_buckets_total").value
+        _train(False, "adam", {"learning_rate": 1e-3}, steps=2,
+               monkeypatch=monkeypatch)
+        assert telemetry.counter(
+            "mxnet_optimizer_fused_buckets_total").value == c0
+    finally:
+        telemetry.disable()
+
+
+def test_steady_state_dispatch_count_and_no_retrace(monkeypatch):
+    """The acceptance invariant: at steady state Trainer.step dispatches
+    exactly ONE fused call per bucket (telemetry-counted), compiles
+    nothing (analysis.runtime.no_retrace), and the executable cache
+    stops growing after the first step."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.analysis import runtime as rt
+    monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1")
+    monkeypatch.setenv("MXNET_OPTIMIZER_BUCKET_MB", "0.002")  # tiny → >1 bucket
+    fus.reset()
+    net = _mlp()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-3})
+    lf = gluon.loss.L2Loss()
+    r = np.random.RandomState(3)
+    x = mx.nd.array(r.randn(4, 16).astype(np.float32))
+    y = mx.nd.array(r.randn(4, 16).astype(np.float32))
+
+    def step():
+        with autograd.record():
+            loss = lf(net(x), y)
+        loss.backward()
+        tr.step(4)
+
+    step()   # warm-up: plans buckets, builds executables
+    step()
+    builds = fus.exec_builds()
+    sig = tuple((tuple(p.data().shape), str(p.data().dtype), 1)
+                for p in tr._params)
+    n_buckets = len(fus.planner().plan(sig))
+    assert n_buckets > 1   # the tiny bound actually split the params
+    telemetry.enable()
+    try:
+        c0 = telemetry.counter("mxnet_optimizer_fused_buckets_total").value
+        u0 = telemetry.counter("mxnet_optimizer_fused_updates_total").value
+        with rt.no_retrace():
+            step()
+        assert telemetry.counter(
+            "mxnet_optimizer_fused_buckets_total").value - c0 == n_buckets
+        assert telemetry.counter(
+            "mxnet_optimizer_fused_updates_total").value - u0 == 1
+    finally:
+        telemetry.disable()
+    assert fus.exec_builds() == builds   # retrace-count invariant
+
+
+def test_save_load_states_resumes_bit_identically(monkeypatch, tmp_path):
+    """Checkpoint round trip through the fused path: states stay in the
+    per-param format and a resumed run continues bit-identically."""
+    monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1")
+
+    def run(resume_at=None):
+        fus.reset()
+        net = _mlp()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-2, "wd": 0.01})
+        lf = gluon.loss.L2Loss()
+        r = np.random.RandomState(3)
+        x = mx.nd.array(r.randn(4, 16).astype(np.float32))
+        y = mx.nd.array(r.randn(4, 16).astype(np.float32))
+        for s in range(6):
+            if s == resume_at:
+                f = str(tmp_path / "states")
+                tr.save_states(f)
+                tr.load_states(f)
+            with autograd.record():
+                loss = lf(net(x), y)
+            loss.backward()
+            tr.step(4)
+        return _params_np(net)
+
+    _assert_bitwise(run(None), run(resume_at=3), "resume")
+
+
+def test_update_multi_api_routes_fused(monkeypatch):
+    """Optimizer.update_multi (adam) is the fused entry: one call updates
+    N params bitwise like N update_multi_precision calls."""
+    monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1")
+    r = np.random.RandomState(0)
+    shapes = [(8, 8), (8,), (4, 8)]
+
+    def mk():
+        ws = [nd.array(r2.standard_normal(s).astype(np.float32))
+              for s in shapes]
+        return ws
+
+    r2 = np.random.RandomState(0)
+    ws_a = [nd.array(r2.standard_normal(s).astype(np.float32)) for s in shapes]
+    r2 = np.random.RandomState(0)
+    ws_b = [nd.array(r2.standard_normal(s).astype(np.float32)) for s in shapes]
+    gs = [nd.array(r.standard_normal(s).astype(np.float32)) for s in shapes]
+
+    opt_a = mx.optimizer.Adam(learning_rate=1e-2, wd=0.01)
+    sts_a = [opt_a.create_state_multi_precision(i, w)
+             for i, w in enumerate(ws_a)]
+    for i in range(3):
+        opt_a.update_multi_precision(i, ws_a[i], gs[i], sts_a[i])
+
+    opt_b = mx.optimizer.Adam(learning_rate=1e-2, wd=0.01)
+    sts_b = [opt_b.create_state_multi_precision(i, w)
+             for i, w in enumerate(ws_b)]
+    opt_b.update_multi([0, 1, 2], ws_b, gs, sts_b)
+
+    for i in range(3):
+        assert ws_a[i].asnumpy().tobytes() == ws_b[i].asnumpy().tobytes()
+        for st_a, st_b in zip(sts_a[i], sts_b[i]):
+            assert st_a.asnumpy().tobytes() == st_b.asnumpy().tobytes()
+
+
+def test_trainstep_fused_matches_per_param(monkeypatch):
+    """parallel.TrainStep with the fused traced update reproduces the
+    per-param traced step (same losses, same final params)."""
+    from mxnet_tpu import parallel
+    import jax
+
+    def run(fused):
+        monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1" if fused else "0")
+        fus.reset()
+        mx.random.seed(11)
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            net.add(gluon.nn.Dense(32, activation="relu", in_units=16))
+            net.add(gluon.nn.Dense(16, in_units=32))
+        net.initialize(mx.initializer.Xavier())
+        mesh = parallel.make_mesh(shape=(1,), devices=jax.devices()[:1])
+        step = parallel.TrainStep(net, lambda o, l: gluon.loss.L2Loss()(o, l),
+                                  mx.optimizer.Adam(learning_rate=1e-3),
+                                  mesh=mesh)
+        r = np.random.RandomState(5)
+        x = nd.array(r.randn(8, 16).astype(np.float32))
+        y = nd.array(r.randn(8, 16).astype(np.float32))
+        losses = [float(step(x, y).asscalar()) for _ in range(3)]
+        assert (step._fused is not None) == fused
+        return losses, _params_np(net)
+
+    la, pa = run(False)
+    lb, pb = run(True)
+    np.testing.assert_allclose(la, lb, rtol=1e-6)
+    for k in pa:
+        np.testing.assert_allclose(pa[k], pb[k], rtol=1e-6, atol=1e-7,
+                                   err_msg=k)
+
+
+def test_donation_invalidates_raw_refs(monkeypatch):
+    """The documented donation invariant: raw jax buffers captured before
+    a fused step are dead after it; the NDArray handles stay valid."""
+    monkeypatch.setenv("MXNET_OPTIMIZER_FUSED", "1")
+    fus.reset()
+    net = _mlp(n_layers=2)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    lf = gluon.loss.L2Loss()
+    x = mx.nd.array(np.ones((2, 16), np.float32))
+    y = mx.nd.array(np.zeros((2, 16), np.float32))
+    with autograd.record():
+        loss = lf(net(x), y)
+    loss.backward()
+    p = list(net.collect_params().values())[0]
+    raw = p.data()._data          # raw jax.Array alias
+    tr.step(2)
+    assert raw.is_deleted()       # donated
+    assert np.isfinite(p.data().asnumpy()).all()  # handle still live
