@@ -53,6 +53,13 @@ HOT_PATHS = {
                          "_update_impl", "_update_aggregated",
                          "_update_fused", "_fused_kind"},
     "optimizer_fusion.py": None,
+    # serving hot path: the per-iteration scheduler core and everything
+    # inside the jitted decode trace (models.py raw bodies + the paged
+    # attention kernel) must stay host-sync-free
+    "serving/engine.py": {"step", "_admit", "_admit_one", "_ensure_blocks",
+                          "_emit", "_req_finished", "_finish", "_preempt"},
+    "serving/models.py": None,
+    "kernels/paged_attention.py": None,
 }
 
 # GC05 additionally audits these (they sit on the per-batch/per-call path
@@ -66,7 +73,7 @@ FLAG_DISCIPLINE_MODULES = set(HOT_PATHS) | {
 THREADED_MODULES = (
     "engine.py", "native.py", "profiler.py", "checkpoint.py",
     "ops/registry.py", "telemetry/", "resilience/",
-    "gluon/data/dataloader.py", "kvstore/sparse_ps.py",
+    "gluon/data/dataloader.py", "kvstore/sparse_ps.py", "serving/",
 )
 
 

@@ -154,6 +154,38 @@ KNOWN_VARS = {
         "Fused-optimizer bucket size bound (MB): same-dtype parameters "
         "group into flat-state buckets of at most this many bytes, one "
         "donated update dispatch each. <= 0 disables optimizer fusion."),
+    # serving engine (ISSUE 6: mx.serving — paged KV + continuous batching)
+    "MXNET_SERVING_BLOCK_TOKENS": (
+        "16", int,
+        "Paged-KV block size (token positions per pool block): sequences "
+        "allocate cache in blocks of this many tokens and a per-sequence "
+        "block table maps positions to blocks, so mixed-length traffic "
+        "shares one fixed-shape pool with no retrace."),
+    "MXNET_SERVING_MAX_BATCH": (
+        "8", int,
+        "Decode slots in the continuous batch — the fixed B of the "
+        "compiled (B, 1) decode step.  Finished sequences' slots are "
+        "backfilled from the queue every iteration."),
+    "MXNET_SERVING_MAX_SEQ": (
+        "256", int,
+        "Longest sequence (prompt + generation) a serving request may "
+        "reach; sets each slot's block-table width.  Requests that could "
+        "exceed it are rejected at submit."),
+    "MXNET_SERVING_NUM_BLOCKS": (
+        "0", int,
+        "KV pool blocks (plus the reserved scratch block 0).  0 = worst "
+        "case (max_batch * blocks_per_seq + 1: no preemption possible); "
+        "smaller pools oversubscribe and rely on preemption-by-recompute."),
+    "MXNET_SERVING_PREFILL_TOKENS": (
+        "64", int,
+        "Fixed padded prompt shape (1, P) the prefill step compiles at — "
+        "prompts above it are rejected; must be <= MXNET_SERVING_MAX_SEQ."),
+    "MXNET_SERVING_SLA_S": (
+        "0", float,
+        "Default per-request SLA deadline (seconds, submit to finish): "
+        "expired requests are evicted (queued or mid-decode) with "
+        "RequestDeadlineExceeded — the serving twin of the resilience "
+        "Deadline policy.  0 = no deadline; submit(deadline_s=) overrides."),
     # native (C++) fast lanes
     "MXNET_USE_NATIVE": (
         "1", int,

@@ -189,11 +189,13 @@ class TransformerModel(HybridBlock):
         x = x + F.expand_dims(pos, axis=0)
         return F.transpose(self.drop(x), axes=(1, 0, 2))   # (L, B, C)
 
-    def hybrid_forward(self, F, src_tokens, tgt_tokens,
-                       src_valid_length=None, embed_weight=None):
+    def _encode_impl(self, F, embed_weight, src_tokens, src_valid_length):
         mem = self._embed(F, embed_weight, src_tokens)
-        mem = self.encoder(mem) if src_valid_length is None \
+        return self.encoder(mem) if src_valid_length is None \
             else self.encoder(mem, src_valid_length)
+
+    def _decode_impl(self, F, embed_weight, mem, tgt_tokens,
+                     src_valid_length):
         y = self._embed(F, embed_weight, tgt_tokens)
         y = self.decoder(y, mem, src_valid_length)
         y = F.transpose(y, axes=(1, 0, 2))                 # (B, Lt, C)
@@ -201,6 +203,31 @@ class TransformerModel(HybridBlock):
         logits = F.dot(y.reshape((-1, self._units)), embed_weight,
                        transpose_b=True)
         return logits.reshape((tgt_tokens.shape[0], tgt_tokens.shape[1], -1))
+
+    def hybrid_forward(self, F, src_tokens, tgt_tokens,
+                       src_valid_length=None, embed_weight=None):
+        mem = self._encode_impl(F, embed_weight, src_tokens,
+                                src_valid_length)
+        return self._decode_impl(F, embed_weight, mem, tgt_tokens,
+                                 src_valid_length)
+
+    def encode(self, src_tokens, src_valid_length=None):
+        """Run the encoder ONCE and return its memory (Ls, B, C) — the
+        half of ``hybrid_forward`` whose inputs never change during
+        autoregressive decode.  Pair with :meth:`decode_from_memory`."""
+        from ... import ndarray as F
+        return self._encode_impl(F, self.embed_weight.data(), src_tokens,
+                                 src_valid_length)
+
+    def decode_from_memory(self, mem, tgt_tokens, src_valid_length=None):
+        """Decoder + tied projection over a cached encoder memory:
+        identical math (and logits) to ``self(src, tgt, vl)`` when ``mem``
+        came from :meth:`encode` on the same source — the decode loops
+        call this every step so the encoder runs once per sentence, not
+        once per emitted token."""
+        from ... import ndarray as F
+        return self._decode_impl(F, self.embed_weight.data(), mem,
+                                 tgt_tokens, src_valid_length)
 
 
 _CONFIGS = {
@@ -230,8 +257,10 @@ def greedy_decode(model, src_tokens, bos_id, eos_id, max_len=64,
     same compiled shape — decoder causality makes the PAD tail beyond the
     current position invisible to the positions that matter, so the
     growing-prefix retrace (a fresh XLA compile per emitted token) never
-    happens.  O(L^2) total work (re-encodes each step — the example/eval
-    path; production serving would cache k/v).  Returns (B, <=max_len)
+    happens.  The source is encoded ONCE and every step decodes against
+    the cached memory; the decoder itself still re-runs the full buffer
+    per step (the example/eval path — ``mx.serving`` is the production
+    path with a paged k/v cache and O(L) decode).  Returns (B, <=max_len)
     int32 including BOS, stopping early only when EVERY sequence has
     emitted EOS.
     """
@@ -248,10 +277,12 @@ def greedy_decode(model, src_tokens, bos_id, eos_id, max_len=64,
     buf[:, 0] = bos_id
     done = np.zeros((B,), bool)
     n = 1
+    # the source never changes across steps: encode ONCE and decode every
+    # step against the cached memory (identical logits to the full call)
+    mem = model.encode(src_tokens, src_valid_length)
     for t in range(max_len - 1):
-        logits = model(src_tokens, mxnd.array(buf),
-                       src_valid_length) if src_valid_length is not None \
-            else model(src_tokens, mxnd.array(buf))
+        logits = model.decode_from_memory(mem, mxnd.array(buf),
+                                          src_valid_length)
         nxt = np.asarray(logits.asnumpy()[:, t].argmax(-1), np.int32)
         nxt = np.where(done, eos_id, nxt)
         buf[:, t + 1] = nxt
@@ -274,9 +305,10 @@ def beam_search_decode(model, src_tokens, bos_id, eos_id, beam_size=4,
     live beam is worse than the pool even with the best possible
     remaining score.  Same fixed-shape discipline as ``greedy_decode``:
     one (B*K, max_len) buffer, one compiled shape per step (causality
-    hides the pad tail).  Host-side numpy picks the beams — the
-    example/eval path; production serving would jit the loop with k/v
-    caches.  Returns (best (B, <=max_len) int32 incl. BOS, scores (B,)
+    hides the pad tail), the replicated source encoded ONCE up front.
+    Host-side numpy picks the beams — the example/eval path; production
+    serving (``mx.serving``) jits the loop with paged k/v caches.
+    Returns (best (B, <=max_len) int32 incl. BOS, scores (B,)
     length-normalized log-probs).
     """
     import numpy as np
@@ -307,10 +339,13 @@ def beam_search_decode(model, src_tokens, bos_id, eos_id, beam_size=4,
     # completed pool: per batch row, the best (normalized_score, tokens)
     best_done = [(-np.inf, None)] * B
     n = 1
+    # the replicated source is step-invariant: one encoder pass feeds
+    # every decode step (and every beam reshuffle — beams share a row's
+    # memory by construction)
+    mem = model.encode(src_rep, vl_rep)
     for t in range(max_len - 1):
         flat = mxnd.array(buf.reshape(B * K, max_len))
-        logits = model(src_rep, flat, vl_rep) if vl_rep is not None \
-            else model(src_rep, flat)
+        logits = model.decode_from_memory(mem, flat, vl_rep)
         # slice + log_softmax ON DEVICE (the registered op — one
         # log-softmax implementation in the codebase), then pull only the
         # (B*K, V) step slice over the tunnel

@@ -1,0 +1,122 @@
+"""Paged-KV attention — the serving engine's decode-step attention core.
+
+vLLM's PagedAttention idea (SOSP'23), shaped for the fixed-shape/no-retrace
+discipline of this rebuild: the KV cache lives in a pool of fixed-size
+*blocks* of ``block_tokens`` positions each, and every sequence owns a
+*block table* — a row of pool indices mapping its logical positions to
+physical blocks.  Sequences of wildly different lengths then share one
+preallocated pool at ONE compiled shape: growing a sequence allocates a
+block (a host-side free-list pop), finishing one returns its blocks, and
+the compiled executable never changes because every operand — pool, block
+tables, context lengths — keeps its shape across iterations.
+
+This module is the dense (XLA-native) implementation: block gathers via
+``pool[table]`` and a masked fp32 softmax, which XLA fuses well at serving
+batch sizes and runs on every backend (CPU tests included).  It is written
+to the same shape contract as the Pallas TPU paged kernel family
+(jax.experimental paged_attention: per-page DMA + online softmax), so a
+Mosaic kernel can slot in behind the same signature later without touching
+the serving engine.  The numerics deliberately mirror
+``ops.contrib._dense_sdpa`` — scores einsum in the input dtype, cast to
+f32, ``-1e9`` masking, fp32 softmax, cast back — so incremental decode is
+token-identical to the full re-encode forward it replaces.
+
+Shape glossary (one layer):
+    k_pool, v_pool : (num_blocks, block_tokens, kv_heads, head_dim)
+    block_table    : (B, max_blocks) int32 — pool indices per sequence
+    ctx_len        : (B,) int32 — positions readable (current included)
+    q              : (B, heads, q_len, head_dim)
+
+Block 0 of every pool is the SCRATCH block: inactive batch slots point
+their whole table at it, so their (discarded) writes land somewhere
+harmless and freed blocks can be re-issued immediately with no zeroing —
+a reused block is only ever read at positions < ctx_len, every one of
+which the new owner has overwritten.
+"""
+
+from __future__ import annotations
+
+__all__ = ["paged_attention", "write_kv", "write_kv_prefill", "SCRATCH_BLOCK"]
+
+# pool index reserved for discarded writes (inactive slots, pad positions)
+SCRATCH_BLOCK = 0
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def paged_attention(q, k_pool, v_pool, block_table, ctx_len,
+                    num_kv_groups=1, sm_scale=None):
+    """Attention of ``q`` against the paged K/V of each sequence.
+
+    ``q`` is (B, H, Lq, D) — Lq is 1 on the decode path; ``ctx_len`` (B,)
+    counts readable positions (the caller writes the current token's k/v
+    FIRST, so ctx_len includes it).  GQA rides ``num_kv_groups`` = H /
+    kv_heads with the same head-major broadcast as
+    ``contrib.masked_att_qkv``.  Returns (B, H, Lq, D).
+    """
+    import jax
+    jnp = _jnp()
+    B, H, Lq, D = q.shape
+    _, T, KV, _ = k_pool.shape
+    MB = block_table.shape[1]
+    S = MB * T
+    # gather: (B, MB, T, KV, D) -> (B, KV, S, D) head-major like _attend
+    k = jnp.transpose(k_pool[block_table].reshape(B, S, KV, D), (0, 2, 1, 3))
+    v = jnp.transpose(v_pool[block_table].reshape(B, S, KV, D), (0, 2, 1, 3))
+    if num_kv_groups > 1:
+        k = jnp.repeat(k, num_kv_groups, axis=1)
+        v = jnp.repeat(v, num_kv_groups, axis=1)
+    scale = sm_scale if sm_scale is not None else 1.0 / float(D) ** 0.5
+    att = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    pos = jnp.arange(S, dtype=jnp.int32)
+    mask = pos[None, None, None, :] < ctx_len[:, None, None, None]
+    att = jnp.where(mask, att, jnp.asarray(-1e9, jnp.float32))
+    p = jax.nn.softmax(att, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def write_kv(k_pool, v_pool, block_table, pos, k_new, v_new):
+    """Scatter one token's k/v per sequence into its block-table slot.
+
+    ``pos`` (B,) is the logical position being written (== ctx_len before
+    the write); ``k_new``/``v_new`` are (B, KV, D).  Returns the updated
+    pools.  Slots the scheduler parked on the scratch table all collide at
+    block 0 — by design, those writes are never read back.
+    """
+    jnp = _jnp()
+    N, T, KV, D = k_pool.shape
+    B = pos.shape[0]
+    blk = jnp.take_along_axis(block_table, (pos // T)[:, None], axis=1)[:, 0]
+    idx = blk * T + pos % T                                   # (B,) flat
+    k_pool = k_pool.reshape(N * T, KV, D).at[idx].set(k_new).reshape(
+        N, T, KV, D)
+    v_pool = v_pool.reshape(N * T, KV, D).at[idx].set(v_new).reshape(
+        N, T, KV, D)
+    return k_pool, v_pool
+
+
+def write_kv_prefill(k_pool, v_pool, block_table_row, valid_len,
+                     k_new, v_new):
+    """Scatter a whole (padded) prompt's k/v into one sequence's blocks.
+
+    ``k_new``/``v_new`` are (P, KV, D) for positions 0..P-1 of ONE
+    sequence; ``block_table_row`` is its (max_blocks,) table;
+    positions >= ``valid_len`` (padding) are routed to the scratch block
+    instead, so the pad tail of the fixed prefill shape never touches a
+    real block.  Returns the updated pools.
+    """
+    jnp = _jnp()
+    N, T, KV, D = k_pool.shape
+    P = k_new.shape[0]
+    pos = jnp.arange(P, dtype=jnp.int32)
+    blk = block_table_row[pos // T]                           # (P,)
+    idx = blk * T + pos % T
+    idx = jnp.where(pos < valid_len, idx, SCRATCH_BLOCK * T + pos % T)
+    k_pool = k_pool.reshape(N * T, KV, D).at[idx].set(k_new).reshape(
+        N, T, KV, D)
+    v_pool = v_pool.reshape(N * T, KV, D).at[idx].set(v_new).reshape(
+        N, T, KV, D)
+    return k_pool, v_pool

@@ -127,6 +127,21 @@ def _pallas_compiles():
     return _PALLAS_PROBE[0]
 
 
+def _backend_is_tpu():
+    """Whether the PROCESS backend is TPU — the guard that keeps the
+    ``lax.platform_dependent`` flash/dense fork out of CPU-only programs.
+    ``platform_dependent`` prunes the losing branch when evaluated
+    eagerly, but under ``jax.jit`` (the registry's default dispatch) it
+    lowers EVERY branch on the compiling platform and the Pallas call's
+    CPU lowering rule raises ("Only interpret mode is supported on CPU
+    backend") — so on a CPU backend the dense math must be emitted
+    directly, not as the default arm of a multi-platform switch.  On a
+    TPU backend the switch stays: host-side eval islands inside a TPU
+    process still resolve per platform at lowering time."""
+    import jax
+    return jax.default_backend() == "tpu"
+
+
 def _flash_eligible(seq, head_dim):
     """Whether the Pallas TPU flash kernel's tiling applies to these shapes
     (lane-aligned seq blocks); the platform choice itself happens at XLA
@@ -209,7 +224,7 @@ def _attend(q, k, v, valid_length, causal):
         steps = jnp.arange(L, dtype=jnp.int32)
         seg = (steps[None, :] < valid_length.astype(jnp.int32)[:, None]) \
             .astype(jnp.int32)                      # (B, L): 1=valid, 0=pad
-    if _flash_eligible(L, D):
+    if _flash_eligible(L, D) and _backend_is_tpu():
         import jax
         from ..kernels.flash_attention import flash_attention
 
@@ -230,7 +245,7 @@ def _attend(q, k, v, valid_length, causal):
             return _dense_sdpa(q, k, v, seg, causal, scale)
 
         # branch resolved per compile platform at lowering time: TPU gets the
-        # Pallas kernel, CPU (tests, host-side eval) the dense fallback
+        # Pallas kernel, CPU host-eval islands the dense fallback
         return jax.lax.platform_dependent(q, k, v, seg,
                                           tpu=_tpu, default=_portable)
     return _dense_sdpa(q, k, v, seg, causal, scale)
@@ -365,7 +380,8 @@ def _masked_encdec_att(q, kv, valid_length=None, heads=1):
         seg_kv = (steps[None, :] < valid_length.astype(jnp.int32)[:, None]) \
             .astype(jnp.int32)                            # (B, Lk)
         seg_q = jnp.ones((B, Lq), jnp.int32)              # queries all valid
-    if _flash_eligible(Lq, D) and _flash_eligible(Lk, D):
+    if _flash_eligible(Lq, D) and _flash_eligible(Lk, D) \
+            and _backend_is_tpu():
         from ..kernels.flash_attention import flash_attention
 
         def _tpu(qh, kh, vh):

@@ -1,0 +1,52 @@
+"""mx.serving — production inference serving (ROADMAP item 3).
+
+The heavy-traffic half of the north star: a request-level serving engine
+over the fixed-shape decode discipline the zoo models already train with.
+Three layers, smallest first:
+
+- ``kernels.paged_attention`` (device) — block-pool KV storage with
+  per-sequence block tables: one compiled shape for every mix of
+  sequence lengths, freed blocks reused instantly (vLLM PagedAttention).
+- ``serving.cache`` (host) — the free-list allocator and block-table /
+  context-length bookkeeping the scheduler mutates between iterations.
+- ``serving.models`` + ``serving.engine`` — jitted fixed-shape prefill
+  and single-token decode for the llama and transformer zoo models
+  (O(L) total FLOPs per sequence instead of the re-encode path's O(L²)),
+  driven by an Orca-style continuous-batching scheduler: an async queue
+  backfills finished slots every iteration, per-request SLA deadlines
+  ride the resilience policy family, and TTFT/TPOT/e2e/queue-depth SLOs
+  flow through the telemetry registry.
+
+Quick start::
+
+    net = llama.llama_model("llama_tiny", vocab_size=256)
+    net.initialize(...)
+    eng = serving.ServingEngine(net, eos_id=2)
+    handle = eng.submit([1, 17, 93], max_new_tokens=32)
+    eng.start()                      # background decode loop
+    tokens = handle.result()
+
+Knobs: ``MXNET_SERVING_BLOCK_TOKENS``, ``MXNET_SERVING_MAX_BATCH``,
+``MXNET_SERVING_MAX_SEQ``, ``MXNET_SERVING_NUM_BLOCKS``,
+``MXNET_SERVING_PREFILL_TOKENS``, ``MXNET_SERVING_SLA_S`` (see README).
+Benchmark: ``benchmark/serve_bench.py`` (CI lane gates FLOPs/token and
+continuous-vs-static throughput).
+"""
+
+from __future__ import annotations
+
+from .cache import BlockAllocator, CacheOOMError, PagedKVCache  # noqa: F401
+from .engine import (  # noqa: F401
+    Request, RequestDeadlineExceeded, ResultHandle, ServingEngine,
+    ServingError,
+)
+from .models import (  # noqa: F401
+    LlamaServingAdapter, TransformerServingAdapter, make_adapter,
+)
+
+__all__ = [
+    "ServingEngine", "Request", "ResultHandle", "ServingError",
+    "RequestDeadlineExceeded", "PagedKVCache", "BlockAllocator",
+    "CacheOOMError", "LlamaServingAdapter", "TransformerServingAdapter",
+    "make_adapter",
+]

@@ -1,0 +1,156 @@
+"""Paged KV cache — host-side block bookkeeping for the serving engine.
+
+The device half of the design lives in ``kernels.paged_attention`` (block
+pool + gather/scatter at one compiled shape); this module is the virtual-
+memory half: a free-list ``BlockAllocator`` and the per-slot block tables /
+context lengths the scheduler mutates between decode iterations.  All of
+it is plain numpy — the device only ever sees fixed-shape int32 uploads of
+the current tables, so allocation and reuse never perturb the compiled
+executable (the no-retrace invariant the decode loop is tested for).
+
+Block 0 is reserved as the scratch block (see kernels.paged_attention):
+inactive slots park their whole table on it and padded prefill positions
+are routed to it, so freed blocks can be handed to a new sequence without
+zeroing — the new owner overwrites every position it will ever read.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..kernels.paged_attention import SCRATCH_BLOCK
+
+__all__ = ["CacheOOMError", "BlockAllocator", "PagedKVCache"]
+
+
+class CacheOOMError(MXNetError):
+    """The block pool cannot satisfy an allocation — the scheduler's cue
+    to defer admission or preempt a running sequence."""
+
+
+class BlockAllocator:
+    """LIFO free list over pool blocks 1..num_blocks-1 (0 is scratch).
+
+    LIFO keeps recently-freed (cache-hot) blocks circulating first and
+    makes reuse immediate — the block-reuse correctness tests lean on
+    that: a just-freed block is the very next one handed out.
+    """
+
+    def __init__(self, num_blocks):
+        if num_blocks < 2:
+            raise MXNetError("paged pool needs >= 2 blocks "
+                             "(block 0 is the scratch block)")
+        self.num_blocks = int(num_blocks)
+        self._free = list(range(self.num_blocks - 1, SCRATCH_BLOCK, -1))
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    def alloc(self, n):
+        """Pop ``n`` blocks or raise CacheOOMError (allocation is
+        all-or-nothing so a half-admitted sequence never exists)."""
+        if n > len(self._free):
+            raise CacheOOMError(
+                f"paged KV cache exhausted: need {n} blocks, "
+                f"{len(self._free)} free of {self.num_blocks - 1} "
+                "(raise MXNET_SERVING_NUM_BLOCKS or lower the batch)")
+        taken = self._free[-n:] if n else []
+        del self._free[len(self._free) - n:]
+        return taken
+
+    def free(self, blocks):
+        for b in blocks:
+            if not (SCRATCH_BLOCK < b < self.num_blocks):
+                raise MXNetError(f"freeing invalid block {b}")
+            if b in self._free:
+                raise MXNetError(f"double free of block {b}")
+        self._free.extend(blocks)
+
+
+class PagedKVCache:
+    """Block tables + context lengths for ``max_batch`` decode slots.
+
+    Owns the allocator and the numpy mirrors of everything the decode
+    step consumes; the engine uploads ``tables``/``ctx_len`` (fixed
+    shapes) each iteration.  Device pools are owned by the model adapter
+    (their layout is per-model); this object is deliberately
+    device-free so it unit-tests without jax.
+    """
+
+    def __init__(self, max_batch, max_blocks_per_seq, block_tokens,
+                 num_blocks):
+        self.max_batch = int(max_batch)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.block_tokens = int(block_tokens)
+        self.allocator = BlockAllocator(num_blocks)
+        # scratch-parked tables: SCRATCH_BLOCK everywhere
+        self.tables = np.full((max_batch, max_blocks_per_seq),
+                              SCRATCH_BLOCK, np.int32)
+        self.ctx_len = np.zeros((max_batch,), np.int32)
+        self._owned = [[] for _ in range(max_batch)]   # slot -> blocks
+        # bumped on every table mutation: the engine re-uploads the device
+        # copy only when this moved (tables change at admission/allocation,
+        # not every decode iteration — steady-state skips the transfer)
+        self.version = 0
+
+    @property
+    def free_blocks(self):
+        return self.allocator.free_blocks
+
+    def blocks_for(self, n_tokens):
+        """Blocks needed to hold ``n_tokens`` cache positions."""
+        return -(-int(n_tokens) // self.block_tokens)
+
+    def admit(self, slot, n_tokens):
+        """Claim blocks for a sequence entering ``slot`` with
+        ``n_tokens`` positions about to be written (its prompt).
+        All-or-nothing; raises CacheOOMError with the slot untouched."""
+        if self._owned[slot]:
+            raise MXNetError(f"slot {slot} already owns blocks")
+        need = self.blocks_for(max(int(n_tokens), 1))
+        if need > self.max_blocks_per_seq:
+            raise CacheOOMError(
+                f"sequence needs {need} blocks > max_blocks_per_seq "
+                f"{self.max_blocks_per_seq} (MXNET_SERVING_MAX_SEQ)")
+        blocks = self.allocator.alloc(need)
+        self._owned[slot] = blocks
+        row = np.full((self.max_blocks_per_seq,), SCRATCH_BLOCK, np.int32)
+        row[:need] = blocks
+        self.tables[slot] = row
+        self.ctx_len[slot] = 0
+        self.version += 1
+        return blocks
+
+    def ensure_capacity(self, slot):
+        """Guarantee the slot's NEXT write position (``ctx_len[slot]``)
+        has a block; allocates one at a block boundary.  Raises
+        CacheOOMError (slot untouched) when the pool is dry — the
+        scheduler then preempts."""
+        pos = int(self.ctx_len[slot])
+        bi = pos // self.block_tokens
+        if bi >= self.max_blocks_per_seq:
+            raise CacheOOMError(
+                f"slot {slot} hit max_blocks_per_seq at position {pos} "
+                "(MXNET_SERVING_MAX_SEQ)")
+        if bi < len(self._owned[slot]):
+            return
+        blk = self.allocator.alloc(1)[0]
+        self._owned[slot].append(blk)
+        self.tables[slot, bi] = blk
+        self.version += 1
+
+    def advance(self, slot, n=1):
+        self.ctx_len[slot] += n
+
+    def release(self, slot):
+        """Return the slot's blocks to the pool and park it on scratch."""
+        blocks = self._owned[slot]
+        self._owned[slot] = []
+        if blocks:
+            self.allocator.free(blocks)
+        self.tables[slot] = SCRATCH_BLOCK
+        self.ctx_len[slot] = 0
+        self.version += 1
+        return blocks

@@ -1,0 +1,574 @@
+"""Continuous-batching serving engine (Orca iteration-level scheduling).
+
+One ``ServingEngine`` owns a model adapter (jitted fixed-shape prefill +
+decode, ``serving.models``), a paged KV cache (``serving.cache``), and an
+async request queue.  Every iteration of :meth:`step`:
+
+1. fails queued/running requests past their SLA deadline
+   (``RequestDeadlineExceeded`` — the per-request twin of the resilience
+   ``Deadline`` policy, same config family);
+2. backfills free decode slots from the queue — a finished sequence's
+   slot is re-used by a waiting request on the very next iteration, which
+   is what makes mixed-length traffic throughput-bound instead of
+   bounded by the longest sequence in a static batch;
+3. runs ONE fixed-shape ``(B_max, 1)`` decode dispatch for every slot
+   (inactive slots ride along pointed at the scratch block) and retires
+   sequences that emitted EOS or their token budget.
+
+Shapes never change across iterations — sequences of any length joining
+and leaving only mutate host-side numpy tables — so the steady-state loop
+holds the no-retrace invariant (``analysis.runtime.no_retrace``), asserted
+by tests/test_serving.py.
+
+When the block pool runs dry mid-decode the scheduler preempts the
+youngest recompute-capable sequence (vLLM's recompute policy: its blocks
+are freed, the request re-queues at the FRONT and later re-prefills with
+prompt + generated-so-far as a longer prompt); adapters that cannot
+recompute (the encoder-decoder) get worst-case block reservations at
+admission instead, so they never face mid-stream OOM.
+
+Blocking waits on results ride ``resilience.Deadline`` — a wedged or dead
+engine thread surfaces as ``KVStoreTimeoutError`` instead of hanging the
+caller forever.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+
+from .. import config
+from .. import telemetry as _tel
+from ..base import MXNetError
+from ..resilience import Deadline, ResilienceError
+from .cache import CacheOOMError, PagedKVCache
+from .models import make_adapter
+
+import numpy as np
+
+__all__ = ["ServingEngine", "Request", "ResultHandle", "ServingError",
+           "RequestDeadlineExceeded"]
+
+
+class ServingError(MXNetError):
+    """Base for serving-layer failures attached to a request."""
+
+
+class RequestDeadlineExceeded(ResilienceError):
+    """A request blew its SLA deadline (queued or mid-decode) and was
+    evicted — the serving twin of the resilience Deadline policy."""
+
+
+# -- telemetry SLOs ---------------------------------------------------------
+
+_M_ADMITTED = _tel.counter(
+    "mxnet_serving_requests_admitted_total",
+    "Requests admitted into a decode slot (re-admissions after "
+    "preemption included).")
+_M_COMPLETED = _tel.counter(
+    "mxnet_serving_requests_completed_total",
+    "Requests that finished with EOS or their max_new_tokens budget.")
+_M_EVICTED = _tel.counter(
+    "mxnet_serving_requests_evicted_total",
+    "Requests failed by SLA deadline (queued or running).")
+_M_PREEMPTED = _tel.counter(
+    "mxnet_serving_requests_preempted_total",
+    "Running sequences preempted (blocks freed, requeued for recompute) "
+    "to relieve block-pool pressure.")
+_M_REJECTED = _tel.counter(
+    "mxnet_serving_requests_rejected_total",
+    "Requests rejected as unservable: submit-time misfits (too long for "
+    "the cache/prefill shape) and admission-time reservations exceeding "
+    "the whole pool.")
+_M_TOKENS = _tel.counter(
+    "mxnet_serving_tokens_total", "Generated tokens emitted to callers.")
+_M_STEPS = _tel.counter(
+    "mxnet_serving_decode_steps_total",
+    "Fixed-shape (B_max, 1) decode dispatches.")
+_M_PREFILLS = _tel.counter(
+    "mxnet_serving_prefills_total", "Prefill dispatches (one per admission).")
+_M_POSITIONS = _tel.counter(
+    "mxnet_serving_token_positions_total",
+    "Token positions computed by the model (padding included): B_max per "
+    "decode step + prefill_tokens per prefill.  FLOPs accounting: "
+    "multiply by the adapter's flops_per_position.")
+_G_QUEUE = _tel.gauge(
+    "mxnet_serving_queue_depth", "Requests waiting for a decode slot.")
+_G_ACTIVE = _tel.gauge(
+    "mxnet_serving_active_slots", "Decode slots currently serving.")
+_G_FREE_BLOCKS = _tel.gauge(
+    "mxnet_serving_free_blocks", "KV pool blocks on the free list.")
+_H_TTFT = _tel.histogram(
+    "mxnet_serving_ttft_seconds", "Submit -> first generated token.")
+_H_TPOT = _tel.histogram(
+    "mxnet_serving_tpot_seconds", "Inter-token interval per sequence.")
+_H_E2E = _tel.histogram(
+    "mxnet_serving_e2e_seconds", "Submit -> request completed.")
+_H_QWAIT = _tel.histogram(
+    "mxnet_serving_queue_wait_seconds", "Submit -> (re-)admission.")
+
+_rid = itertools.count()
+
+
+class Request:
+    """One generation request moving through the engine."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "deadline_s",
+                 "submit_t", "queued_t", "outputs", "error", "done",
+                 "first_token_t", "last_emit_t", "finish_t", "preempts")
+
+    def __init__(self, prompt, max_new_tokens, deadline_s):
+        self.rid = next(_rid)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline_s = deadline_s
+        self.submit_t = time.perf_counter()
+        self.queued_t = self.submit_t
+        self.outputs = []
+        self.error = None
+        self.done = threading.Event()
+        self.first_token_t = None
+        self.last_emit_t = None
+        self.finish_t = None
+        self.preempts = 0
+
+    def expired(self, now):
+        return (self.deadline_s is not None and self.deadline_s > 0
+                and now - self.submit_t > self.deadline_s)
+
+
+class ResultHandle:
+    """Caller-side view of a submitted request."""
+
+    def __init__(self, req):
+        self._req = req
+
+    @property
+    def rid(self):
+        return self._req.rid
+
+    def ready(self):
+        return self._req.done.is_set()
+
+    def stats(self):
+        """Per-request SLO sample (seconds): ttft, e2e, tokens, preempts —
+        what serve_bench aggregates into p50/p99.  ``finish_t`` is the
+        absolute completion timestamp (time.perf_counter clock) for
+        sustained-throughput accounting."""
+        req = self._req
+        return {
+            "ttft_s": (None if req.first_token_t is None
+                       else req.first_token_t - req.submit_t),
+            "e2e_s": (None if req.finish_t is None
+                      else req.finish_t - req.submit_t),
+            "finish_t": req.finish_t,
+            "tokens": len(req.outputs),
+            "preempts": req.preempts,
+        }
+
+    def result(self, timeout=None):
+        """Block for the generated tokens.  The wait itself is bounded by
+        ``resilience.Deadline`` (default ``MXNET_KVSTORE_TIMEOUT_S``): if
+        the engine thread died, the caller gets KVStoreTimeoutError
+        instead of hanging forever.  Request-level failures (SLA
+        eviction, rejection) re-raise here."""
+        if not self._req.done.is_set():
+            Deadline(timeout_s=timeout, site="serving.result").call(
+                self._req.done.wait)
+        if self._req.error is not None:
+            raise self._req.error
+        return list(self._req.outputs)
+
+
+class _Slot:
+    __slots__ = ("req", "last_token", "admitted_t")
+
+    def __init__(self, req, last_token, now):
+        self.req = req
+        self.last_token = last_token
+        self.admitted_t = now
+
+
+class ServingEngine:
+    """Paged-KV continuous-batching server for one zoo model.
+
+    ``policy='continuous'`` backfills slots every iteration (the serving
+    default); ``policy='static'`` admits a fresh batch only once every
+    slot has drained — kept as the benchmark baseline serve_bench
+    compares against.
+    """
+
+    def __init__(self, model, eos_id=None, bos_id=None, max_batch=None,
+                 block_tokens=None, max_seq=None, num_blocks=None,
+                 prefill_tokens=None, policy="continuous"):
+        if policy not in ("continuous", "static"):
+            raise MXNetError(f"policy {policy!r}: want continuous|static")
+        self.policy = policy
+        self.max_batch = int(max_batch if max_batch is not None else
+                             config.get_int("MXNET_SERVING_MAX_BATCH", 8))
+        self.block_tokens = int(
+            block_tokens if block_tokens is not None else
+            config.get_int("MXNET_SERVING_BLOCK_TOKENS", 16))
+        max_seq = int(max_seq if max_seq is not None else
+                      config.get_int("MXNET_SERVING_MAX_SEQ", 256))
+        prefill_tokens = int(
+            prefill_tokens if prefill_tokens is not None else
+            config.get_int("MXNET_SERVING_PREFILL_TOKENS", 64))
+        if prefill_tokens > max_seq:
+            raise MXNetError("MXNET_SERVING_PREFILL_TOKENS must be <= "
+                             "MXNET_SERVING_MAX_SEQ")
+        self.max_seq = max_seq
+        mbs = -(-max_seq // self.block_tokens)
+        if num_blocks is None:
+            num_blocks = config.get_int("MXNET_SERVING_NUM_BLOCKS", 0)
+        if not num_blocks:                 # worst case every slot maxed out
+            num_blocks = self.max_batch * mbs + 1
+        if hasattr(model, "decode") and hasattr(model, "prefill"):
+            self.adapter = model
+        else:
+            self.adapter = make_adapter(model, eos_id=eos_id, bos_id=bos_id,
+                                        prefill_tokens=prefill_tokens,
+                                        max_batch=self.max_batch)
+        self.eos_id = self.adapter.eos_id
+        limit = getattr(self.adapter, "max_positions", None)
+        if limit is not None and max_seq > limit:
+            raise MXNetError(
+                f"max_seq {max_seq} exceeds the model's positional table "
+                f"({limit} rows): decode positions past it would clamp "
+                f"and emit wrong tokens — lower MXNET_SERVING_MAX_SEQ or "
+                f"build the model with max_length >= {max_seq}")
+        self.cache = PagedKVCache(self.max_batch, mbs, self.block_tokens,
+                                  num_blocks)
+        self.adapter.make_pools(num_blocks, self.block_tokens)
+        self.default_sla_s = config.get_float("MXNET_SERVING_SLA_S", 0.0)
+        self._lock = threading.Lock()      # queue + slots + cache
+        self._queue = collections.deque()
+        self._slots = [None] * self.max_batch
+        self._tables_dev = None            # device copy of cache.tables
+        self._tables_version = -1
+        self._thread = None
+        self._running = False
+        self._stopped = False              # stop() is terminal
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=32, deadline_s=None):
+        """Queue one request; returns a :class:`ResultHandle`.  Requests
+        that can never fit (prompt beyond the prefill shape, total beyond
+        max_seq) are rejected immediately."""
+        if deadline_s is None:
+            deadline_s = self.default_sla_s or None
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+        req = Request(prompt, max_new_tokens, deadline_s)
+        if req.max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        if not req.prompt:
+            raise MXNetError("empty prompt")
+        total = self.adapter.cache_positions(len(req.prompt),
+                                             req.max_new_tokens)
+        if len(req.prompt) > self.adapter.prefill_tokens \
+                or total > self.max_seq:
+            _M_REJECTED.inc()
+            req.error = ServingError(
+                f"request {req.rid} cannot fit: prompt {len(req.prompt)} "
+                f"(prefill cap {self.adapter.prefill_tokens}), cache "
+                f"positions {total} (max_seq {self.max_seq})")
+            req.finish_t = time.perf_counter()
+            req.done.set()
+            return ResultHandle(req)
+        with self._lock:
+            if self._stopped:
+                req.error = ServingError(
+                    f"request {req.rid} rejected: engine stopped")
+                req.finish_t = time.perf_counter()
+                req.done.set()
+                return ResultHandle(req)
+            self._queue.append(req)
+            _G_QUEUE.set(len(self._queue))
+        return ResultHandle(req)
+
+    # -- scheduling core ----------------------------------------------------
+
+    def _finish(self, slot_idx, error=None):
+        slot = self._slots[slot_idx]
+        self._slots[slot_idx] = None  # graftcheck: ignore[GC04] — helper only called from step()/_admit with self._lock held
+        self.cache.release(slot_idx)
+        req = slot.req
+        req.error = error
+        now = time.perf_counter()
+        req.finish_t = now
+        if error is None:
+            _M_COMPLETED.inc()
+            _H_E2E.observe(now - req.submit_t)
+        req.done.set()
+
+    def _evict(self, req, where):
+        req.error = RequestDeadlineExceeded(
+            f"request {req.rid} exceeded its {req.deadline_s:g}s SLA "
+            f"deadline while {where} (MXNET_SERVING_SLA_S)")
+        req.finish_t = time.perf_counter()
+        _M_EVICTED.inc()
+        req.done.set()
+
+    def _preempt(self, slot_idx):
+        """Free a running sequence's blocks and requeue it (front) for
+        recompute — prompt + generated-so-far re-prefills later."""
+        slot = self._slots[slot_idx]
+        self._slots[slot_idx] = None  # graftcheck: ignore[GC04] — helper only called from step() with self._lock held
+        self.cache.release(slot_idx)
+        slot.req.preempts += 1
+        slot.req.queued_t = time.perf_counter()
+        # the preemption round-trip (queue wait + re-prefill) is NOT an
+        # inter-token interval: without this the first post-readmission
+        # emit would observe it into the TPOT histogram
+        slot.req.last_emit_t = None
+        self._queue.appendleft(slot.req)
+        _M_PREEMPTED.inc()
+
+    def _recompute_prompt(self, req):
+        return req.prompt + req.outputs
+
+    def _admissible(self, req):
+        """Blocks to reserve at admission: optimistic (prompt only) when
+        the adapter can recompute after preemption, worst case (whole
+        token budget) when it cannot."""
+        if self.adapter.supports_recompute:
+            return max(len(self._recompute_prompt(req)), 1)
+        return max(req.max_new_tokens - len(req.outputs), 1)
+
+    def _emit(self, req, token, now):
+        req.outputs.append(int(token))
+        _M_TOKENS.inc()
+        if req.first_token_t is None:
+            req.first_token_t = now
+            _H_TTFT.observe(now - req.submit_t)
+        elif req.last_emit_t is not None:
+            _H_TPOT.observe(now - req.last_emit_t)
+        req.last_emit_t = now
+
+    def _req_finished(self, req):
+        return (req.outputs and req.outputs[-1] == self.eos_id) \
+            or len(req.outputs) >= req.max_new_tokens
+
+    def _admit_one(self, req, slot_idx):
+        """Prefill one request into a free slot.  Raises CacheOOMError
+        with nothing mutated if the pool can't cover the reservation."""
+        now = time.perf_counter()
+        if self.adapter.supports_recompute:
+            prompt = self._recompute_prompt(req)
+        else:
+            prompt = req.prompt
+        self.cache.admit(slot_idx, self._admissible(req))
+        _H_QWAIT.observe(now - req.queued_t)
+        try:
+            with _tel.span("serving.prefill", "serving", rid=req.rid):
+                first = self.adapter.prefill(slot_idx, prompt,
+                                             self.cache.tables[slot_idx])
+        except Exception:
+            # the blocks claimed above must not leak with the slot empty —
+            # a poisoned slot would crash every later admission into it
+            self.cache.release(slot_idx)
+            raise
+        _M_PREFILLS.inc()
+        _M_POSITIONS.inc(self.adapter.prefill_tokens)
+        _M_ADMITTED.inc()
+        if self.adapter.first_token_from_prefill:
+            # prompt tokens (incl. recomputed generations) now sit in
+            # the pages; the new token decodes next iteration
+            self.cache.ctx_len[slot_idx] = len(prompt)
+            self._emit(req, first, time.perf_counter())
+            last = first
+        else:
+            self.cache.ctx_len[slot_idx] = 0
+            last = self.adapter.bos_id
+        self._slots[slot_idx] = _Slot(req, last, now)  # graftcheck: ignore[GC04] — helper only called from _admit under step()'s self._lock
+        if self._req_finished(req):
+            self._finish(slot_idx)
+
+    def _admit(self, now):
+        # SLA sweep of the WHOLE queue first — a dead queued request must
+        # unblock its caller this iteration even when admission is gated
+        # (static policy mid-batch, pool pressure)
+        expired = [r for r in self._queue if r.expired(now)]
+        for req in expired:
+            self._queue.remove(req)
+            self._evict(req, "queued")
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if self.policy == "static" and len(free) < self.max_batch:
+            return
+        while self._queue and free:
+            req = self._queue.popleft()
+            try:
+                self._admit_one(req, free[0])
+            except CacheOOMError as oom:
+                if any(s is not None for s in self._slots):
+                    self._queue.appendleft(req)  # blocks will free; wait
+                    break
+                # nothing running will ever free blocks: permanent misfit
+                req.error = oom
+                req.finish_t = time.perf_counter()
+                _M_REJECTED.inc()
+                req.done.set()
+                continue
+            except Exception as exc:  # noqa: BLE001 — adapter failure
+                # prefill failed (device error, adapter bug): fail THIS
+                # request and keep serving the rest; blocks were released
+                # by _admit_one
+                req.error = exc
+                req.finish_t = time.perf_counter()
+                req.done.set()
+                continue
+            free.pop(0)
+
+    def _ensure_blocks(self, now):
+        """Every active slot's next write position gets a block;
+        pool pressure preempts the youngest recompute-capable slot."""
+        del now
+        for i in range(self.max_batch):
+            while self._slots[i] is not None:
+                try:
+                    self.cache.ensure_capacity(i)
+                    break
+                except CacheOOMError as oom:
+                    victims = sorted(
+                        (j for j, s in enumerate(self._slots)
+                         if s is not None
+                         and self.adapter.supports_recompute
+                         and len(self._recompute_prompt(s.req))
+                         <= self.adapter.prefill_tokens),
+                        key=lambda j: self._slots[j].admitted_t)
+                    if not victims:
+                        self._finish(i, error=oom)
+                        break
+                    self._preempt(victims[-1])
+                    # if i preempted itself the outer while exits below
+
+    def step(self):
+        """One scheduler iteration (expire → backfill → decode → retire).
+        Returns True when any work was done — the background loop idles
+        briefly on False."""
+        with self._lock:
+            now = time.perf_counter()
+            # SLA check on running sequences first: no compute for the dead
+            for i, slot in enumerate(self._slots):
+                if slot is not None and slot.req.expired(now):
+                    req = slot.req
+                    self._slots[i] = None
+                    self.cache.release(i)
+                    self._evict(req, "decoding")
+            self._admit(now)
+            self._ensure_blocks(now)
+            active = [i for i, s in enumerate(self._slots) if s is not None]
+            did_work = bool(active)
+            if active:
+                tokens = np.zeros((self.max_batch,), np.int32)
+                for i in active:
+                    tokens[i] = self._slots[i].last_token
+                if self._tables_version != self.cache.version:
+                    # tables only change at admission/allocation/release —
+                    # the steady-state iteration skips this upload
+                    import jax.numpy as jnp
+                    self._tables_dev = jnp.asarray(self.cache.tables)
+                    self._tables_version = self.cache.version
+                # the dispatch runs under self._lock on purpose: released,
+                # a finished slot could be backfilled mid-dispatch and this
+                # step's tokens credited to the wrong request (lock-free
+                # needs per-slot generation tags; submit() waiting out one
+                # decode step is the accepted cost)
+                with _tel.span("serving.decode_step", "serving",
+                               batch=len(active)):
+                    nxt = self.adapter.decode(tokens, self._tables_dev,
+                                              self.cache.ctx_len)
+                _M_STEPS.inc()
+                _M_POSITIONS.inc(self.max_batch)
+                now = time.perf_counter()
+                for i in active:
+                    slot = self._slots[i]
+                    if slot is None:
+                        continue          # preempted under pressure
+                    self.cache.advance(i)
+                    tok = int(nxt[i])
+                    slot.last_token = tok
+                    self._emit(slot.req, tok, now)
+                    if self._req_finished(slot.req):
+                        self._finish(i)
+            _G_QUEUE.set(len(self._queue))
+            _G_ACTIVE.set(sum(s is not None for s in self._slots))
+            _G_FREE_BLOCKS.set(self.cache.free_blocks)
+            return did_work or bool(self._queue)
+
+    # -- driving ------------------------------------------------------------
+
+    def drain(self, max_steps=100000):
+        """Run the scheduler until queue and slots are empty (the
+        synchronous mode tests and benchmarks use)."""
+        for _ in range(max_steps):
+            if not self.step():
+                with self._lock:
+                    idle = not self._queue \
+                        and all(s is None for s in self._slots)
+                if idle:
+                    return
+        raise MXNetError("serving drain did not converge "
+                         f"within {max_steps} steps")
+
+    def generate(self, prompts, max_new_tokens=32, deadline_s=None):
+        """Submit a batch and run synchronously to completion; returns
+        each prompt's generated tokens (EOS included when emitted)."""
+        handles = [self.submit(p, max_new_tokens, deadline_s)
+                   for p in prompts]
+        self.drain()
+        return [h.result(timeout=1.0) for h in handles]
+
+    def start(self):
+        """Serve from a background daemon thread (the async mode:
+        ``submit`` from any thread, ``ResultHandle.result`` to wait)."""
+        with self._lock:
+            if self._stopped:
+                raise MXNetError("engine stopped: stop() is terminal")
+            if self._running:
+                return
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._serve_loop, daemon=True, name="mx-serving")
+            self._thread.start()
+
+    def _serve_loop(self):
+        while True:
+            with self._lock:
+                if not self._running:
+                    return
+            if not self.step():
+                time.sleep(0.001)
+
+    def stop(self):
+        """TERMINAL shutdown: stop the background loop and FAIL every
+        pending request — an abandoned handle must error promptly, not
+        sit on the full resilience-Deadline timeout waiting for a loop
+        that is gone.  Later submit()s return already-failed handles."""
+        with self._lock:
+            self._running = False
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10)
+        with self._lock:
+            self._stopped = True
+            pending = list(self._queue)
+            self._queue.clear()
+            for i, slot in enumerate(self._slots):
+                if slot is not None:
+                    self._slots[i] = None
+                    self.cache.release(i)
+                    pending.append(slot.req)
+            for req in pending:
+                req.error = ServingError(
+                    f"request {req.rid} abandoned: engine stopped "
+                    "before it completed")
+                req.finish_t = time.perf_counter()
+                req.done.set()
+            _G_QUEUE.set(0)
+            _G_ACTIVE.set(0)
+            _G_FREE_BLOCKS.set(self.cache.free_blocks)

@@ -30,6 +30,7 @@ def test_train_mnist_learns():
     assert hist[-1]["loss"] < hist[0]["loss"]
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 def test_train_resnet_reports_throughput():
     mod = _load("image_classification/train_resnet.py")
     rec = mod.run(model="resnet18_v1", batch_size=4, image_size=32,
@@ -37,6 +38,7 @@ def test_train_resnet_reports_throughput():
     assert rec["images_per_sec"] > 0
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 def test_bert_pretrain_loss_drops():
     mod = _load("bert/pretrain.py")
     rec = mod.run(num_layers=2, units=64, heads=4, batch=8, seq_len=32,
@@ -52,6 +54,7 @@ def test_lstm_lm_perplexity_drops():
     assert hist[-1]["perplexity"] < hist[0]["perplexity"]
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 def test_ssd_trains_and_detects():
     mod = _load("ssd/train_ssd.py")
     rec = mod.run(batch=16, steps=40, log=False)
@@ -72,6 +75,7 @@ def test_moe_example_expert_parallel():
     assert rec["last_loss"] < rec["first_loss"]
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 def test_quantize_net_example():
     mod = _load("quantization/quantize_net.py")
     rec = mod.run(model="resnet18_v1", batch=4, image_size=32, classes=10,
@@ -92,6 +96,7 @@ def test_matrix_factorization_model_parallel():
                                rtol=1e-4)
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 def test_dist_train_example_two_workers():
     """The examples/distributed lane end-to-end: 2 localhost workers via
     tools/launch.py, dist_tpu_sync Trainer, loss drops, exact grad-sum
@@ -135,6 +140,7 @@ def test_launch_ssh_command_construction():
     assert codes == [0, 0]
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 def test_transformer_mt_learns():
     mod = _load("transformer_mt/train_mt.py")
     rec = mod.run(vocab=24, layers=1, units=32, hidden=64, heads=2,

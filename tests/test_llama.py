@@ -105,6 +105,7 @@ def test_tp_dp_mesh_train_step(seeded):
     assert all(np.isfinite(l) for l in losses)
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
 def test_sp_attention_train_step_parity(impl, seeded):
     """Sequence-parallel llama (contrib.sp_att_qkv over a dp×sp mesh)

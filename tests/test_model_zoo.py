@@ -42,6 +42,7 @@ def test_small_models_forward(name, seeded):
     assert out.shape == (2, 10)
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 def test_resnet_thumbnail_trains(seeded):
     # CIFAR-style lane: thumbnail avoids the 7x7/maxpool stem
     net = vision.resnet18_v1(classes=4, thumbnail=True)
@@ -142,6 +143,7 @@ def test_label_smoothed_ce_loss():
     np.testing.assert_allclose(l_pad[0], want0, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 def test_yolo3_structure_and_targets(seeded):
     from mxnet_tpu.gluon.model_zoo import yolo
     net = yolo.YOLOV3(

@@ -4,6 +4,7 @@ the optimizer aggregation the reference drives through
 MXNET_OPTIMIZER_AGGREGATION_SIZE)."""
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon, nd, profiler
@@ -104,6 +105,7 @@ def test_trainer_aggregated_matches_per_param(monkeypatch):
                                    err_msg=k)
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 def test_aggregation_reduces_dispatch_count(monkeypatch):
     """The point of the multi-tensor path: fewer host dispatches per step
     (reference: one multi_sgd kernel per aggregate group).  Counted via

@@ -34,6 +34,7 @@ def _mesh(n):
     return jax.sharding.Mesh(np.array(devs), ("sp",))
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_matches_dense(causal, seeded):
     B, H, L, D, n = 2, 3, 32, 8, 4
@@ -49,6 +50,7 @@ def test_ring_matches_dense(causal, seeded):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 def test_ring_segment_mask(seeded):
     B, H, L, D, n = 2, 2, 16, 4, 4
     r = np.random.RandomState(1)

@@ -1,0 +1,392 @@
+"""Serving engine tests (ISSUE 6): paged KV cache + continuous batching.
+
+The load-bearing assertions:
+- incremental paged decode is TOKEN-IDENTICAL to the full re-encode
+  forward, across batch sizes, block sizes, and early-EOS patterns;
+- block reuse (free -> realloc) cannot leak stale KV into a new sequence;
+- the steady-state decode loop holds the no-retrace invariant while
+  sequences of different lengths join and leave the batch;
+- SLA deadlines evict, preemption-by-recompute converges, telemetry SLOs
+  populate.
+
+One shared llama engine config keeps the jit-compile count low — the
+jitted decode/prefill entries are module-level in serving.models, so
+engines with equal config + shapes share executables.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving, telemetry
+from mxnet_tpu.analysis.runtime import no_retrace
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo import llama, transformer
+from mxnet_tpu.serving.cache import BlockAllocator, CacheOOMError
+
+EOS = 2
+BOS = 1
+
+
+@pytest.fixture(scope="module")
+def llama_net():
+    mx.random.seed(7)
+    np.random.seed(7)
+    net = llama.llama_model("llama_tiny", vocab_size=101)
+    net.initialize(mx.initializer.Normal(0.05))
+    net(mx.nd.array(np.zeros((1, 4), np.int32)))     # finish deferred init
+    return net
+
+
+@pytest.fixture(scope="module")
+def tf_net():
+    mx.random.seed(11)
+    np.random.seed(11)
+    m = transformer.transformer_model("transformer_test", vocab_size=50,
+                                      max_length=32, dropout=0.0)
+    m.initialize(mx.initializer.Normal(0.3))
+    return m
+
+
+def _llama_engine(net, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("block_tokens", 4)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("prefill_tokens", 16)
+    return serving.ServingEngine(net, eos_id=EOS, **kw)
+
+
+def _ref_greedy_llama(net, prompt, max_new, eos=EOS, pad_to=32):
+    """Oracle: full re-encode greedy decode on a fixed (1, pad_to) buffer
+    (causality hides the tail — one compiled shape)."""
+    assert len(prompt) + max_new <= pad_to
+    buf = np.zeros((1, pad_to), np.int32)
+    buf[0, :len(prompt)] = prompt
+    n, out = len(prompt), []
+    for _ in range(max_new):
+        logits = net(mx.nd.array(buf)).asnumpy()
+        nxt = int(logits[0, n - 1].argmax())
+        out.append(nxt)
+        if nxt == eos:
+            break
+        buf[0, n] = nxt
+        n += 1
+    return out
+
+
+# -- allocator / cache units (no jax) ---------------------------------------
+
+def test_block_allocator_alloc_free_oom():
+    a = BlockAllocator(6)                 # blocks 1..5 usable
+    assert a.free_blocks == 5
+    got = a.alloc(3)
+    assert len(got) == 3 and a.free_blocks == 2
+    with pytest.raises(CacheOOMError):
+        a.alloc(3)
+    a.free(got)
+    assert a.free_blocks == 5
+    with pytest.raises(MXNetError, match="double free"):
+        a.free(got[:1])                   # already on the free list
+
+
+def test_block_allocator_scratch_reserved():
+    a = BlockAllocator(4)
+    taken = a.alloc(3)
+    assert 0 not in taken                 # scratch never issued
+    with pytest.raises(MXNetError, match="invalid block"):
+        a.free([0])
+
+
+def test_paged_cache_admit_release_reuse():
+    c = serving.PagedKVCache(max_batch=2, max_blocks_per_seq=4,
+                             block_tokens=4, num_blocks=9)
+    blocks = c.admit(0, 7)                # ceil(7/4) = 2 blocks
+    assert len(blocks) == 2 and c.free_blocks == 6
+    c.ctx_len[0] = 7
+    c.ensure_capacity(0)                  # pos 7 inside block 1: no alloc
+    assert c.free_blocks == 6
+    c.ctx_len[0] = 8
+    c.ensure_capacity(0)                  # pos 8 opens block 2
+    assert c.free_blocks == 5
+    freed = c.release(0)
+    assert len(freed) == 3 and c.free_blocks == 8
+    assert (c.tables[0] == 0).all() and c.ctx_len[0] == 0
+    reused = c.admit(1, 4)                # LIFO: the freed block comes back
+    assert reused[0] in freed
+
+
+# -- llama: token identity ---------------------------------------------------
+
+def test_llama_paged_decode_token_identical(llama_net):
+    """Mixed-length prompts through the continuous batch == per-request
+    full re-encode greedy decode, token for token."""
+    eng = _llama_engine(llama_net)
+    prompts = [[5, 9, 11], [7, 8, 9, 10, 3, 4], [40, 41], [12] * 9]
+    outs = eng.generate(prompts, max_new_tokens=12)
+    for p, got in zip(prompts, outs):
+        assert got == _ref_greedy_llama(llama_net, p, 12), p
+
+
+@pytest.mark.parametrize("block_tokens", [2, 8])
+def test_llama_block_sizes_token_identical(llama_net, block_tokens):
+    eng = _llama_engine(llama_net, block_tokens=block_tokens)
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+    outs = eng.generate(prompts, max_new_tokens=9)
+    for p, got in zip(prompts, outs):
+        assert got == _ref_greedy_llama(llama_net, p, 9), p
+
+
+def test_llama_batch_size_independent(llama_net):
+    """The same request decodes identically alone and in a full batch
+    (B_max=2 vs 4 engines) — slot count is not observable."""
+    p = [6, 28, 3, 17]
+    solo = _llama_engine(llama_net, max_batch=2).generate(
+        [p], max_new_tokens=10)[0]
+    crowd = _llama_engine(llama_net).generate(
+        [p, [1, 2, 3], [50] * 7, [30, 31]], max_new_tokens=10)[0]
+    assert solo == crowd == _ref_greedy_llama(llama_net, p, 10)
+
+
+def test_llama_early_eos_and_backfill(llama_net):
+    """Sequences that stop early (engineered EOS) free their slots for
+    queued requests; every request still matches its oracle."""
+    prompts = [[5, 9, 11], [7, 8, 9, 10, 3, 4], [40, 41], [12] * 9,
+               [33, 2, 7], [64, 65, 66, 67], [90], [13, 37]]
+    refs = [_ref_greedy_llama(llama_net, p, 10, eos=-1) for p in prompts]
+    # eos = what request 0 emits 3rd: its row ends early, others vary
+    eos = refs[0][2]
+    net_refs = [_ref_greedy_llama(llama_net, p, 10, eos=eos)
+                for p in prompts]
+    eng = serving.ServingEngine(llama_net, eos_id=eos, max_batch=3,
+                                block_tokens=4, max_seq=64,
+                                prefill_tokens=16)
+    outs = eng.generate(prompts, max_new_tokens=10)   # 8 reqs, 3 slots
+    assert outs == net_refs
+    assert any(o[-1] == eos and len(o) < 10 for o in outs)  # early stop real
+
+
+def test_llama_block_reuse_no_stale_kv(llama_net):
+    """free -> realloc cannot leak stale KV: a request decoded over
+    just-freed (never zeroed) blocks matches a fresh-engine decode.
+    The LIFO allocator guarantees the probe gets the churned blocks."""
+    eng = _llama_engine(llama_net)
+    churn = eng.generate([[23, 24, 25, 26, 27, 28], [71, 72, 73]],
+                         max_new_tokens=14)
+    probe = [44, 45, 46, 47]
+    probe_blocks = None
+    orig_admit = eng.cache.admit
+
+    def spying_admit(slot, n):
+        nonlocal probe_blocks
+        probe_blocks = orig_admit(slot, n)
+        return probe_blocks
+
+    eng.cache.admit = spying_admit
+    reused = eng.generate([probe], max_new_tokens=14)[0]
+    fresh = _llama_engine(llama_net).generate([probe],
+                                              max_new_tokens=14)[0]
+    assert reused == fresh == _ref_greedy_llama(llama_net, probe, 14)
+    assert churn and probe_blocks  # pool churned, probe really realloc'd
+
+
+# -- no-retrace invariant ----------------------------------------------------
+
+def test_no_retrace_mixed_lengths(llama_net):
+    """Acceptance: the steady-state decode loop compiles NOTHING while
+    sequences of differing lengths join and leave the batch."""
+    eng = _llama_engine(llama_net)
+    eng.generate([[5, 6, 7], [8, 9, 10, 11, 12]], max_new_tokens=6)  # warm
+    with no_retrace():
+        outs = eng.generate(
+            [[1], [2, 3], [4, 5, 6, 7], [9] * 11, [10, 11], [12] * 7],
+            max_new_tokens=9)
+    assert len(outs) == 6 and all(len(o) == 9 for o in outs)
+
+
+# -- scheduling: deadlines, preemption, async -------------------------------
+
+def test_sla_deadline_evicts(llama_net):
+    eng = _llama_engine(llama_net)
+    before = telemetry.counter(
+        "mxnet_serving_requests_evicted_total").value
+    h = eng.submit([5, 6, 7], max_new_tokens=8, deadline_s=1e-9)
+    import time
+    time.sleep(0.01)
+    eng.step()
+    with pytest.raises(serving.RequestDeadlineExceeded, match="SLA"):
+        h.result(timeout=5)
+    after = telemetry.counter("mxnet_serving_requests_evicted_total").value
+    assert after == before + 1
+
+
+def test_reject_oversized(llama_net):
+    eng = _llama_engine(llama_net)
+    h = eng.submit(list(range(3, 20)), max_new_tokens=4)   # > prefill cap
+    with pytest.raises(serving.ServingError, match="cannot fit"):
+        h.result(timeout=5)
+    h2 = eng.submit([5, 6], max_new_tokens=63)             # > max_seq
+    with pytest.raises(serving.ServingError, match="cannot fit"):
+        h2.result(timeout=5)
+
+
+def test_preemption_recompute_converges(llama_net):
+    """An oversubscribed pool (too small for both sequences' full length)
+    forces preemption; the preempted request re-prefills with
+    prompt+generated and still matches its oracle exactly."""
+    before = telemetry.counter(
+        "mxnet_serving_requests_preempted_total").value
+    # eos 255 is never emitted (vocab 101): both sequences must run their
+    # full 10 tokens, oversubscribing the 4-block pool (7 blocks demand)
+    eng = serving.ServingEngine(llama_net, eos_id=255, max_batch=2,
+                                block_tokens=4, max_seq=16,
+                                prefill_tokens=16, num_blocks=5)
+    prompts = [[5, 9, 11, 13], [7, 8, 9, 10]]
+    outs = eng.generate(prompts, max_new_tokens=10)
+    for p, got in zip(prompts, outs):
+        assert got == _ref_greedy_llama(llama_net, p, 10, eos=-1), p
+    after = telemetry.counter(
+        "mxnet_serving_requests_preempted_total").value
+    assert after > before                 # pressure actually preempted
+
+
+def test_async_background_thread(llama_net):
+    eng = _llama_engine(llama_net)
+    eng.start()
+    try:
+        hs = [eng.submit(p, max_new_tokens=7)
+              for p in ([15, 16], [17, 18, 19], [20])]
+        results = [h.result(timeout=60) for h in hs]
+    finally:
+        eng.stop()
+    for p, got in zip([[15, 16], [17, 18, 19], [20]], results):
+        assert got == _ref_greedy_llama(llama_net, p, 7)
+
+
+def test_stop_fails_pending_requests(llama_net):
+    """stop() must error abandoned handles promptly — not leave callers
+    blocked on the full resilience-Deadline timeout."""
+    eng = _llama_engine(llama_net)
+    h = eng.submit([5, 6, 7], max_new_tokens=8)   # queued, loop never ran
+    eng.stop()
+    with pytest.raises(serving.ServingError, match="abandoned"):
+        h.result(timeout=5)
+    assert h.stats()["e2e_s"] is not None         # terminal -> finish_t set
+    assert eng.cache.free_blocks == eng.cache.allocator.num_blocks - 1
+    late = eng.submit([8, 9], max_new_tokens=4)   # stop() is terminal
+    with pytest.raises(serving.ServingError, match="stopped"):
+        late.result(timeout=5)
+
+
+def test_static_policy_matches_tokens(llama_net):
+    """policy='static' (the bench baseline) produces the same tokens —
+    only the scheduling differs."""
+    prompts = [[5, 9, 11], [7, 8, 9], [40, 41], [12, 13], [1, 2, 3]]
+    cont = _llama_engine(llama_net).generate(prompts, max_new_tokens=6)
+    stat = _llama_engine(llama_net, policy="static").generate(
+        prompts, max_new_tokens=6)
+    assert cont == stat
+
+
+# -- transformer (encoder-decoder) ------------------------------------------
+
+def test_transformer_paged_decode_token_identical(tf_net):
+    """Paged incremental MT decode == greedy_decode (the re-encode path)
+    for every row, including a padded short source."""
+    r = np.random.RandomState(0)
+    src = r.randint(3, 50, (3, 8)).astype(np.int32)
+    vls = [8, 6, 4]
+    ref = transformer.greedy_decode(
+        tf_net, mx.nd.array(src), BOS, EOS, max_len=12,
+        src_valid_length=mx.nd.array(np.array(vls, np.int32)))
+    eng = serving.ServingEngine(tf_net, eos_id=EOS, bos_id=BOS,
+                                max_batch=4, block_tokens=4, max_seq=16,
+                                prefill_tokens=16)
+    outs = eng.generate([list(src[i, :vls[i]]) for i in range(3)],
+                        max_new_tokens=11)
+    for i, got in enumerate(outs):
+        want = list(ref[i, 1:])           # strip BOS
+        assert got[:len(want)] == want[:len(got)], (i, got, want)
+
+
+def test_transformer_rejects_max_seq_past_pos_table(tf_net):
+    """max_seq beyond the sinusoid table must error at construction —
+    jnp.take would clamp those decode positions and emit wrong tokens."""
+    with pytest.raises(MXNetError, match="positional table"):
+        serving.ServingEngine(tf_net, eos_id=EOS, bos_id=BOS,
+                              max_batch=2, block_tokens=4, max_seq=64,
+                              prefill_tokens=16)   # tf_net max_length=32
+
+
+def test_transformer_no_retrace(tf_net):
+    eng = serving.ServingEngine(tf_net, eos_id=EOS, bos_id=BOS,
+                                max_batch=4, block_tokens=4, max_seq=16,
+                                prefill_tokens=16)
+    eng.generate([[5, 6, 7]], max_new_tokens=4)          # warm
+    with no_retrace():
+        outs = eng.generate([[8, 9], [10, 11, 12, 13], [14]],
+                            max_new_tokens=6)
+    assert all(len(o) == 6 for o in outs)
+
+
+# -- encode-once satellite ---------------------------------------------------
+
+def test_encode_once_matches_full_forward(tf_net):
+    """encode() + decode_from_memory() == the one-shot hybrid forward —
+    the contract that lets greedy/beam decode encode the source once."""
+    r = np.random.RandomState(3)
+    src = mx.nd.array(r.randint(3, 50, (2, 7)).astype(np.int32))
+    tgt = mx.nd.array(r.randint(3, 50, (2, 5)).astype(np.int32))
+    vl = mx.nd.array(np.array([7, 4], np.int32))
+    full = tf_net(src, tgt, vl).asnumpy()
+    mem = tf_net.encode(src, vl)
+    two_step = tf_net.decode_from_memory(mem, tgt, vl).asnumpy()
+    np.testing.assert_allclose(full, two_step, rtol=1e-5, atol=1e-6)
+
+
+def test_greedy_decode_counts_one_encoder_pass(tf_net, monkeypatch):
+    """greedy_decode must hit the encoder exactly once however many
+    tokens it emits."""
+    calls = {"n": 0}
+    orig = type(tf_net).encode
+
+    def counting(self, *a, **kw):
+        calls["n"] += 1
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(type(tf_net), "encode", counting)
+    src = mx.nd.array(np.array([[5, 6, 7, 8]], np.int32))
+    out = transformer.greedy_decode(tf_net, src, BOS, EOS, max_len=8)
+    assert out.shape[0] == 1 and calls["n"] == 1
+
+
+# -- telemetry SLOs ----------------------------------------------------------
+
+def test_serving_telemetry_slos(llama_net):
+    telemetry.enable()
+    try:
+        t0 = telemetry.counter("mxnet_serving_tokens_total").value
+        s0 = telemetry.counter("mxnet_serving_decode_steps_total").value
+        p0 = telemetry.counter(
+            "mxnet_serving_token_positions_total").value
+        ttft = telemetry.REGISTRY.get("mxnet_serving_ttft_seconds")
+        e2e = telemetry.REGISTRY.get("mxnet_serving_e2e_seconds")
+        h0, e0 = ttft.count, e2e.count
+        eng = _llama_engine(llama_net)
+        outs = eng.generate([[5, 6], [7, 8, 9]], max_new_tokens=5)
+        n_tokens = sum(len(o) for o in outs)
+        assert telemetry.counter(
+            "mxnet_serving_tokens_total").value == t0 + n_tokens
+        steps = telemetry.counter(
+            "mxnet_serving_decode_steps_total").value - s0
+        assert steps >= 4                   # 5 new tokens, first via prefill
+        positions = telemetry.counter(
+            "mxnet_serving_token_positions_total").value - p0
+        # 2 prefills at the padded shape + B_max per decode step
+        assert positions == 2 * eng.adapter.prefill_tokens \
+            + steps * eng.max_batch
+        assert ttft.count == h0 + 2 and e2e.count == e0 + 2
+        assert telemetry.gauge("mxnet_serving_queue_depth").value == 0
+        assert telemetry.gauge("mxnet_serving_active_slots").value == 0
+    finally:
+        if not telemetry.env_enabled():
+            telemetry.disable()

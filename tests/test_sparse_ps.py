@@ -169,6 +169,7 @@ def test_ps_errors():
         ps.init("k", mx.nd.zeros((2, 1)))
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 def test_fm_example_trains():
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))), "examples", "sparse"))

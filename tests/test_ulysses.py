@@ -60,6 +60,7 @@ def test_ulysses_grad_matches_dense():
                                rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow  # >10s on the tier-1 budget clock (r7 audit); runs in the CI slow lane
 def test_ulysses_matches_ring():
     from mxnet_tpu.kernels.ulysses import ulysses_sequence_parallel_attention
     from mxnet_tpu.kernels.ring_attention import sequence_parallel_attention
