@@ -60,6 +60,11 @@ HOT_PATHS = {
                           "_emit", "_req_finished", "_finish", "_preempt"},
     "serving/models.py": None,
     "kernels/paged_attention.py": None,
+    # io decode pipeline (ISSUE 7): the per-batch scheduler/collector core
+    # and the worker decode body are the input-bound hot path
+    "io/pipeline.py": {"next_batch", "_assemble_loop", "_collect", "_pump",
+                       "_issue", "_inline_chunk", "_decode_chunk",
+                       "_read_payload", "_attach_slab"},
 }
 
 # GC05 additionally audits these (they sit on the per-batch/per-call path
@@ -74,6 +79,7 @@ THREADED_MODULES = (
     "engine.py", "native.py", "profiler.py", "checkpoint.py",
     "ops/registry.py", "telemetry/", "resilience/",
     "gluon/data/dataloader.py", "kvstore/sparse_ps.py", "serving/",
+    "io/pipeline.py",
 )
 
 

@@ -60,6 +60,31 @@ KNOWN_VARS = {
         "Span ring-buffer capacity (events); oldest events drop beyond it."),
     # data pipeline
     "MXNET_CPU_WORKER_NTHREADS": ("1", int, "Worker threads for host-side data aug."),
+    # multi-core decode pipeline (ISSUE 7: io/pipeline.py)
+    "MXNET_IO_POOL": (
+        "1", int,
+        "If 1 (default), ImageRecordIter(preprocess_threads>1) and "
+        "DataLoader over decode-aware datasets run the shared-memory "
+        "multi-process decode pipeline (bit-identical batches); 0 forces "
+        "in-process decode everywhere."),
+    "MXNET_IO_PREFETCH": (
+        "2", int,
+        "Batches the decode pipeline keeps in flight ahead of the "
+        "consumer (shared-memory slab count is this + 1 — host memory "
+        "scales with it).  2 = double buffering: one batch consumed, two "
+        "decoding."),
+    "MXNET_IO_CHUNK": (
+        "0", int,
+        "Records per decode-pool task.  0 = auto (one task wave per "
+        "batch across the worker pool; stragglers hide behind the next "
+        "prefetched batch's queued chunks)."),
+    "MXNET_IO_TIMEOUT_S": (
+        "60", float,
+        "Deadline (seconds) on one decode chunk.  A worker that blows it "
+        "is treated as hung: the pool is hard-killed (a late write into "
+        "a recycled slab must be impossible), the chunk re-decodes "
+        "in-process, and the degradation ladder (MXNET_DATALOADER_RETRIES) "
+        "advances."),
     # testing / RNG (reference: tests/python/unittest/common.py)
     "MXNET_TEST_SEED": (None, int, "Per-test RNG seed override."),
     "MXNET_MODULE_SEED": (None, int, "Module-wide RNG seed override."),
@@ -84,6 +109,14 @@ KNOWN_VARS = {
         "If 1, imperative op dispatch goes through a per-(op,shape,dtype,attrs) "
         "jax.jit cache; if 0, ops run op-by-op eagerly."),
     "MXNET_SHOW_ENV": ("0", int, "Print the env-var catalog at import (1.7 parity)."),
+    "MXNET_GELU_TANH": (
+        "0", int,
+        "If 1, gelu (the op, LeakyReLU act_type='gelu', and "
+        "gluon.nn.GELU) defaults to the tanh approximation "
+        "0.5x(1+tanh(sqrt(2/pi)(x+0.044715x^3))) instead of the exact erf "
+        "form — the cheaper PROFILE.md lever for the seq-512 MFU target. "
+        "An explicit approximate= attr always wins; read when an op/block "
+        "first resolves, so set it before building the model."),
     "MXNET_PARAMS_FORMAT": (
         "npz", str,
         "Default mx.nd.save container: 'npz' (rich: sparse/bf16) or 'dmlc' "

@@ -100,17 +100,34 @@ class DataLoader:
         # loading (ISSUE 3 graceful degradation)
         self._max_pool_failures = config.get_int("MXNET_DATALOADER_RETRIES", 2)
         self._pool = None
-        if self._num_workers > 0:
+        self._io_pipeline = None
+        self._io_pipeline_slots = 0
+        self._io_pipeline_busy = False
+        self._decode_pool_failures = 0
+        # decode-aware datasets (ISSUE 7: vision.DecodedImageRecordDataset)
+        # publish a decode plan; with workers and the default batchify, the
+        # loader skips the generic pickle pool entirely and drives the
+        # shared-memory decode pipeline instead — bit-identical batches,
+        # zero image bytes through pickle
+        self._use_decode_pool = (
+            self._num_workers > 0
+            and batchify_fn is None
+            and hasattr(dataset, "_decode_plan")
+            and config.get_int("MXNET_IO_POOL", 1) != 0)
+        if self._num_workers > 0 and not self._use_decode_pool:
             self._pool = mp.get_context("fork").Pool(
                 self._num_workers, initializer=_worker_init,
                 initargs=(dataset,))
 
-    def _materialize(self, batch_idx):
+    def _materialize(self, batch_idx, hit_chaos=True):
         """In-process fetch + batchify of one batch (the synchronous path
-        and the pool-failure fallback; chaos site ``dataloader.fetch``)."""
+        and the pool-failure fallback; chaos site ``dataloader.fetch``).
+        Fallback continuations pass ``hit_chaos=False``: they ARE the
+        fault handler, and re-entering the armed site inside the handler
+        would turn an injected transient into an epoch crash."""
         with _tel.span("dataloader.batch", "data",
                        samples=len(batch_idx)) as sp:
-            if _chaos._ACTIVE:
+            if hit_chaos and _chaos._ACTIVE:
                 _chaos.hit("dataloader.fetch")
             batch = self._batchify_fn(
                 [self._dataset[i] for i in batch_idx])
@@ -120,11 +137,87 @@ class DataLoader:
         return batch
 
     def __iter__(self):
+        if self._use_decode_pool:
+            yield from self._iter_decode_pool()
+            return
         if self._pool is None:
             for batch_idx in self._batch_sampler:
                 yield self._materialize(batch_idx)
             return
         yield from self._iter_pool()
+
+    def _iter_decode_pool(self):
+        """Shared-memory decode-pipeline path (ISSUE 7): the epoch's batch
+        plan goes to io.pipeline.PooledDecodePipeline — worker processes
+        decode records straight into shared slabs ahead of the consumer,
+        with the same in-process-refetch → permanent-single-process
+        degradation ladder as the generic pool (a fault here — chaos at
+        ``dataloader.fetch``, a pipeline error past ITS OWN internal
+        ladder — finishes the epoch via ``_materialize``, which decodes
+        the same per-index seeds, so the batch bytes don't change; past
+        ``MXNET_DATALOADER_RETRIES`` episodes the loader abandons the
+        pipeline for good).  The pipeline (and its worker pool) persists
+        across epochs."""
+        import warnings
+        from ...io.pipeline import PooledDecodePipeline
+        if self._io_pipeline_busy:
+            # nested/concurrent iteration: the pipeline is ONE ordered
+            # stream — a second epoch through it would drain the active
+            # generator's schedule and steal its batches.  Decode this
+            # iteration in-process instead (same per-index seeds → same
+            # bytes), matching the synchronous path's semantics.
+            for b in self._batch_sampler:
+                yield self._materialize(list(b))
+            return
+        self._io_pipeline_busy = True
+        try:
+            rec, cfg, keys, seed_fn = self._dataset._decode_plan()
+            batches = [list(b) for b in self._batch_sampler]
+            if not batches:
+                return
+            slots = max(len(b) for b in batches)
+            if self._io_pipeline is None or self._io_pipeline_slots < slots:
+                if self._io_pipeline is not None:
+                    self._io_pipeline.close()
+                self._io_pipeline = PooledDecodePipeline(
+                    rec, cfg, workers=self._num_workers, slots=slots)
+                self._io_pipeline_slots = slots
+            pipe = self._io_pipeline
+            pipe.drain()
+            pipe.begin([([keys[i] for i in b], [seed_fn(i) for i in b])
+                        for b in batches])
+            for bi in range(len(batches)):
+                try:
+                    with _tel.span("dataloader.batch", "data") as sp:
+                        if _chaos._ACTIVE:
+                            _chaos.hit("dataloader.fetch")
+                        # private arrays, materialized off-slab by the
+                        # pipeline's assembler thread — safe for nd.array
+                        # to zero-copy-alias
+                        imgs, labels = pipe.next_batch()
+                        out = (nd.array(imgs), nd.array(labels))
+                except Exception as exc:  # noqa: BLE001 — ladder, not crash
+                    self._decode_pool_failures += 1
+                    pipe.drain()
+                    permanent = \
+                        self._decode_pool_failures > self._max_pool_failures
+                    if permanent:
+                        self._use_decode_pool = False
+                        self._shutdown_pool()
+                    warnings.warn(
+                        f"DataLoader decode pipeline failed ({exc!r}); "
+                        + ("degrading permanently to single-process loading"
+                           if permanent else
+                           "finishing this epoch in-process"), stacklevel=2)
+                    for bj in range(bi, len(batches)):
+                        yield self._materialize(batches[bj], hit_chaos=False)
+                    return
+                if sp is not _tel.NULL_SPAN:
+                    _M_BATCHES.inc()
+                    _M_BATCH_SECONDS.observe(sp.duration_s)
+                yield out
+        finally:
+            self._io_pipeline_busy = False
 
     def _iter_pool(self):
         """Async pool path with bounded prefetch.  A crashed or hung
@@ -186,15 +279,18 @@ class DataLoader:
                 results.clear()
                 self._shutdown_pool()
                 for batch_idx in pending:
-                    yield self._materialize(batch_idx)
+                    yield self._materialize(batch_idx, hit_chaos=False)
                 for batch_idx in it:
-                    yield self._materialize(batch_idx)
+                    yield self._materialize(batch_idx, hit_chaos=False)
                 return
 
     def _shutdown_pool(self):
         pool, self._pool = self._pool, None
         if pool is not None:
             pool.terminate()
+        pipe, self._io_pipeline = self._io_pipeline, None
+        if pipe is not None:
+            pipe.close()
 
     def __len__(self):
         return len(self._batch_sampler)

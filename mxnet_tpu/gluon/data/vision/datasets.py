@@ -189,3 +189,65 @@ class ImageFolderDataset(Dataset):
         if self._transform is not None:
             return self._transform(img, label)
         return img, label
+
+
+class DecodedImageRecordDataset(Dataset):
+    """Decode-aware RecordIO dataset (ISSUE 7): ``(CHW float32 image,
+    float32 label)`` samples with the full ImageRecordIter augmentation
+    config — crop/mirror/normalize resolved at decode time from a
+    per-INDEX RNG seed, so sample ``i`` is the same bytes no matter who
+    decodes it.  That determinism is what lets ``DataLoader`` route this
+    dataset through the multi-core shared-memory decode pool
+    (io/pipeline.py) when ``num_workers > 0``: pooled batches are
+    bit-identical to ``num_workers=0`` in-process loading.
+
+    ``part_index``/``num_parts`` shard the record set for distributed
+    loaders (the ImageRecordIter sharding contract).
+    """
+
+    def __init__(self, filename, data_shape, path_imgidx=None,
+                 rand_crop=False, rand_mirror=False, mean=(0.0, 0.0, 0.0),
+                 std=(1.0, 1.0, 1.0), resize=-1, part_index=0, num_parts=1,
+                 seed=0):
+        from .... import config, recordio
+        idx_path = path_imgidx or os.path.splitext(filename)[0] + ".idx"
+        if not os.path.exists(idx_path):
+            raise MXNetError(
+                f"DecodedImageRecordDataset requires an index file "
+                f"({idx_path}); create it with tools/im2rec.py")
+        self._rec = recordio.MXIndexedRecordIO(idx_path, filename, "r")
+        self._keys = list(self._rec.keys)[part_index::num_parts]
+        self._seed = int(seed)
+        self._cfg = {
+            "rec_path": filename,
+            "data_shape": tuple(data_shape),
+            "resize": resize,
+            "rand_crop": bool(rand_crop),
+            "rand_mirror": bool(rand_mirror),
+            "mean": _np.asarray(mean, _np.float32),
+            "std": _np.asarray(std, _np.float32),
+            "native": bool(config.get_int("MXNET_USE_NATIVE", 1)),
+        }
+
+    def __len__(self):
+        return len(self._keys)
+
+    def set_seed(self, seed):
+        """Re-seed the per-index augmentation stream (e.g. per epoch)."""
+        self._seed = int(seed)
+
+    def _sample_seed(self, idx):
+        from ....io.io import _mix_seed
+        return _mix_seed(self._seed, idx)
+
+    def __getitem__(self, idx):
+        from ....io.io import _decode_record
+        raw = self._rec.read_idx(self._keys[idx])
+        img, label = _decode_record(
+            raw, self._cfg, _np.random.RandomState(self._sample_seed(idx)))
+        return img, label
+
+    def _decode_plan(self):
+        """The DataLoader decode-pool protocol: (reader, cfg, keys,
+        per-index seed fn)."""
+        return self._rec, self._cfg, self._keys, self._sample_seed

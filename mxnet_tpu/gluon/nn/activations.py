@@ -47,8 +47,24 @@ class SELU(HybridBlock):
 
 
 class GELU(HybridBlock):
+    """GELU activation — exact erf form by default; ``approximate=True``
+    (or MXNET_GELU_TANH=1 at construction) selects the tanh
+    approximation.  The choice is resolved HERE, not at trace time, so
+    it rides the op's attr set into the jit cache key."""
+
+    def __init__(self, approximate=None, **kwargs):
+        super().__init__(**kwargs)
+        if approximate is None:
+            from ... import config
+            approximate = bool(config.get_int("MXNET_GELU_TANH", 0))
+        self._approximate = bool(approximate)
+
     def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type="gelu")
+        return F.LeakyReLU(x, act_type="gelu",
+                           approximate=self._approximate)
+
+    def __repr__(self):
+        return f"GELU(approximate={self._approximate})"
 
 
 class Swish(HybridBlock):

@@ -6,3 +6,4 @@ from .io import (  # noqa: F401
     DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
     CSVIter, MNISTIter, ImageRecordIter, LibSVMIter,
 )
+from .pipeline import PooledDecodePipeline  # noqa: F401

@@ -224,7 +224,8 @@ class PrefetchingIter(DataIter):
             iters = [iters]
         super().__init__(iters[0].batch_size)
         self.iters = iters
-        self._queue = _queue.Queue(maxsize=prefetch_depth)
+        self._depth = max(1, prefetch_depth)
+        self._queue = _queue.Queue(maxsize=self._depth)
         self._stop = threading.Event()
         self._thread = None
         self._start()
@@ -261,7 +262,7 @@ class PrefetchingIter(DataIter):
         for i in self.iters:
             i.reset()
         self._stop = threading.Event()
-        self._queue = _queue.Queue(maxsize=2)
+        self._queue = _queue.Queue(maxsize=self._depth)
         self._start()
 
     def next(self):
@@ -377,9 +378,27 @@ class LibSVMIter(DataIter):
         return max(0, end - len(self._rows))
 
 
-def _decode_record(raw, cfg):
-    """Decode + augment one packed image record (pure function so it runs
-    in thread OR process workers — reference ParseChunk body).
+def _mix_seed(seed, k):
+    """Deterministic per-(seed, k) 32-bit stream split (splitmix-style
+    avalanche) — the augmentation RNG contract: record k of an epoch gets
+    the SAME draws no matter which worker (or the parent) decodes it."""
+    h = (int(seed) ^ (int(k) * 0x9E3779B1)) & 0xFFFFFFFF
+    h = (h ^ (h >> 16)) * 0x85EBCA6B & 0xFFFFFFFF
+    h = (h ^ (h >> 13)) * 0xC2B2AE35 & 0xFFFFFFFF
+    return (h ^ (h >> 16)) & 0xFFFFFFFF
+
+
+def _decode_record(raw, cfg, rng, out=None):
+    """Decode + augment one packed image record (pure function of
+    (record bytes, cfg, rng) so it runs bit-identically in the parent, a
+    thread, or a decode-pool process — reference ParseChunk body).
+
+    ``rng`` supplies every augmentation draw (crop origin, mirror coin);
+    callers derive it per record index via ``_mix_seed`` so pooled and
+    single-process decode see the same stream.  ``out`` (a float32 CHW
+    view, e.g. a shared-memory batch-slab slot) receives the pixels in
+    place — the native lane writes it directly from C with no
+    intermediate copy.
 
     Fast lane: when the native fused decoder is available (src/
     jpeg_decode.cc — the reference's ParseChunk/libjpeg-turbo role) and no
@@ -401,16 +420,16 @@ def _decode_record(raw, cfg):
         if dims is not None and dims[0] >= w and dims[1] >= h:
             iw, ih = dims
             if cfg["rand_crop"]:
-                x0 = _np.random.randint(0, iw - w + 1)
-                y0 = _np.random.randint(0, ih - h + 1)
+                x0 = rng.randint(0, iw - w + 1)
+                y0 = rng.randint(0, ih - h + 1)
             else:
                 x0, y0 = (iw - w) // 2, (ih - h) // 2
-            mirror = bool(cfg["rand_mirror"]) and _np.random.rand() < 0.5
-            out = native.jpeg_decode_crop_norm(
+            mirror = bool(cfg["rand_mirror"]) and rng.rand() < 0.5
+            res = native.jpeg_decode_crop_norm(
                 img_bytes, (h, w), crop_xy=(x0, y0), mirror=mirror,
-                mean=cfg["mean"], std=cfg["std"])
-            if out is not None:
-                return out, _np.float32(label)
+                mean=cfg["mean"], std=cfg["std"], out=out)
+            if res is not None:
+                return res, _np.float32(label)
     import cv2   # only the fallback path needs opencv
     img = cv2.imdecode(_np.frombuffer(img_bytes, _np.uint8),
                        cv2.IMREAD_COLOR)
@@ -426,16 +445,20 @@ def _decode_record(raw, cfg):
         img = cv2.resize(img, (max(w, iw), max(h, ih)))
         ih, iw = img.shape[:2]
     if cfg["rand_crop"]:
-        y0 = _np.random.randint(0, ih - h + 1)
-        x0 = _np.random.randint(0, iw - w + 1)
+        y0 = rng.randint(0, ih - h + 1)
+        x0 = rng.randint(0, iw - w + 1)
     else:
         y0, x0 = (ih - h) // 2, (iw - w) // 2
     img = img[y0:y0 + h, x0:x0 + w]
-    if cfg["rand_mirror"] and _np.random.rand() < 0.5:
+    if cfg["rand_mirror"] and rng.rand() < 0.5:
         img = img[:, ::-1]
     img = img.astype(_np.float32)
     img = (img - cfg["mean"]) / cfg["std"]
-    return img.transpose(2, 0, 1), _np.float32(label)
+    chw = img.transpose(2, 0, 1)
+    if out is not None:
+        out[:] = chw
+        return out, _np.float32(label)
+    return chw, _np.float32(label)
 
 
 _DECODE_CFG = None
@@ -444,7 +467,6 @@ _DECODE_CFG = None
 def _decode_worker_init(cfg):
     global _DECODE_CFG
     _DECODE_CFG = cfg
-    _np.random.seed((os.getpid() * 2654435761) % (2 ** 31))
     # decode workers must not oversubscribe: each is single-image work
     try:
         import cv2
@@ -453,28 +475,38 @@ def _decode_worker_init(cfg):
         pass
 
 
-def _decode_worker(raw):
-    return _decode_record(raw, _DECODE_CFG)
+def _decode_worker(raw_seed):
+    raw, seed = raw_seed
+    return _decode_record(raw, _DECODE_CFG, _np.random.RandomState(seed))
 
 
 class ImageRecordIter(DataIter):
     """reference src/io/iter_image_recordio_2.cc — the ImageNet pipeline:
-    RecordIO shards + threaded JPEG decode + augmentation + prefetch.
+    RecordIO shards + multi-core JPEG decode + augmentation + prefetch.
 
     Supported params mirror the reference's ImageRecordParam/augmenters:
     data_shape, batch_size, shuffle, rand_crop, rand_mirror, mean_[rgb],
-    std_[rgb], resize, part_index/num_parts (dist sharding).
+    std_[rgb], resize, part_index/num_parts (dist sharding), seed.
+
+    ``preprocess_threads=N`` with the default ``decoder='pool'`` runs the
+    shared-memory decode pipeline (io.pipeline): N persistent worker
+    processes pread record spans and native-decode straight into
+    shared-memory batch slabs while the consumer runs — batches are
+    BIT-IDENTICAL to ``preprocess_threads=1`` (same records, same
+    per-index augmentation RNG).  'threads'/'processes' keep the legacy
+    in-batch map pools.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size=1, shuffle=False,
                  rand_crop=False, rand_mirror=False, mean_r=0.0, mean_g=0.0,
                  mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0, resize=-1,
                  part_index=0, num_parts=1, preprocess_threads=4,
-                 label_width=1, path_imgidx=None, decoder="threads",
-                 ctx=None, **kwargs):  # noqa: ARG002
+                 label_width=1, path_imgidx=None, decoder="pool",
+                 seed=None, ctx=None, **kwargs):  # noqa: ARG002
         super().__init__(batch_size)
-        if decoder not in ("threads", "processes"):
-            raise MXNetError(f"decoder {decoder!r}: want threads|processes")
+        if decoder not in ("pool", "threads", "processes"):
+            raise MXNetError(
+                f"decoder {decoder!r}: want pool|threads|processes")
         self._decoder = decoder
         # ctx=cpu keeps batches host-side (training loops copy/overlap on
         # their own schedule — the reference iterator also yields CPU
@@ -499,10 +531,18 @@ class ImageRecordIter(DataIter):
         self.mean = _np.array([mean_r, mean_g, mean_b], _np.float32)
         self.std = _np.array([std_r, std_g, std_b], _np.float32)
         self.resize = resize
+        # base seed governs shuffle order AND per-record augmentation
+        # draws; None draws one from the ambient numpy RNG so default
+        # construction stays randomized yet the whole epoch is replayable
+        self._seed = int(seed) if seed is not None \
+            else int(_np.random.randint(0, 2 ** 31 - 1))
+        self._epoch = -1
+        self._epoch_seed = 0
         self._order = _np.arange(len(self._keys))
         self._cursor = -batch_size
         self._threads = max(1, preprocess_threads)
-        self._pool = None       # decode pool, created lazily, reused
+        self._pool = None       # legacy decode pool, created lazily, reused
+        self._pipeline = None   # shared-memory decode pipeline (decoder=pool)
         self.reset()
 
     def close(self):
@@ -512,6 +552,9 @@ class ImageRecordIter(DataIter):
             else:                       # multiprocessing.Pool
                 self._pool.terminate()
             self._pool = None
+        if self._pipeline is not None:
+            self._pipeline.close()
+            self._pipeline = None
 
     def __del__(self):
         try:
@@ -529,25 +572,74 @@ class ImageRecordIter(DataIter):
 
     def reset(self):
         self._cursor = -self.batch_size
+        self._epoch += 1
+        self._epoch_seed = _mix_seed(self._seed, self._epoch)
         if self.shuffle:
-            _np.random.shuffle(self._order)
+            # epoch-seeded shuffle: two iterators built with the same seed
+            # walk identical record orders epoch after epoch (the pooled
+            # vs single-process bit-identity contract)
+            _np.random.RandomState(self._epoch_seed).shuffle(self._order)
+        if self._pipeline is not None:
+            self._pipeline.drain()
+            self._pipeline.begin(self._epoch_schedule())
 
     def iter_next(self):
         self._cursor += self.batch_size
         return self._cursor + self.batch_size <= len(self._keys)
 
     def _cfg(self):
-        return {"data_shape": self.data_shape, "resize": self.resize,
+        from .. import config as _config
+        return {"rec_path": self._rec_path,
+                "data_shape": self.data_shape, "resize": self.resize,
                 "rand_crop": self.rand_crop, "rand_mirror": self.rand_mirror,
-                "mean": self.mean, "std": self.std}
+                "mean": self.mean, "std": self.std,
+                "native": bool(_config.get_int("MXNET_USE_NATIVE", 1))}
 
-    def _decode_one(self, raw):
-        return _decode_record(raw, self._cfg())
+    def _seed_at(self, pos):
+        """Augmentation seed of epoch-stream position ``pos``."""
+        return _mix_seed(self._epoch_seed, pos)
+
+    def _epoch_schedule(self):
+        """The epoch's full batch plan [(keys, seeds), ...] — the pipeline
+        prefetches ahead of the consumer from this."""
+        nb = len(self._keys) // self.batch_size
+        out = []
+        for b in range(nb):
+            idxs = self._order[b * self.batch_size:(b + 1) * self.batch_size]
+            keys = [self._keys[i] for i in idxs]
+            seeds = [self._seed_at(b * self.batch_size + j)
+                     for j in range(len(idxs))]
+            out.append((keys, seeds))
+        return out
+
+    def _use_pipeline(self):
+        from .. import config as _config
+        return (self._decoder == "pool" and self._threads > 1
+                and _config.get_int("MXNET_IO_POOL", 1))
 
     def next(self):
         if not self.iter_next():
             raise StopIteration
+        if self._use_pipeline():
+            if self._pipeline is None:
+                from .pipeline import PooledDecodePipeline
+                self._pipeline = PooledDecodePipeline(
+                    self._rec, self._cfg(), workers=self._threads,
+                    slots=self.batch_size)
+                self._pipeline.begin(self._epoch_schedule())
+                # the schedule was installed at the CURRENT cursor's epoch;
+                # skip batches the consumer already took (none normally —
+                # the pipeline is built on the first next())
+                for _ in range(self._cursor // self.batch_size):
+                    self._pipeline.next_batch()
+            # private arrays, already materialized off-slab by the
+            # pipeline's assembler thread; nd.array may zero-copy-alias
+            # them into the device buffer — they are ours alone
+            imgs, labels = self._pipeline.next_batch()
+            return DataBatch([nd.array(imgs, ctx=self._ctx)],
+                             [nd.array(labels, ctx=self._ctx)], pad=0)
         idxs = self._order[self._cursor:self._cursor + self.batch_size]
+        seeds = [self._seed_at(self._cursor + j) for j in range(len(idxs))]
         # fetch ALL raw records in one pass (native bulk read when built)
         # BEFORE fanning out: per-thread read_idx would race seek/read on
         # the shared file handle, and the C scan beats per-record seeks
@@ -578,11 +670,17 @@ class ImageRecordIter(DataIter):
                     from concurrent.futures import ThreadPoolExecutor
                     self._pool = ThreadPoolExecutor(self._threads)
             if self._decoder == "processes":
-                results = self._pool.map(_decode_worker, raws)
+                results = self._pool.map(_decode_worker, list(zip(raws, seeds)))
             else:
-                results = list(self._pool.map(self._decode_one, raws))
+                cfg = self._cfg()
+                results = list(self._pool.map(
+                    lambda rs: _decode_record(
+                        rs[0], cfg, _np.random.RandomState(rs[1])),
+                    zip(raws, seeds)))
         else:
-            results = [self._decode_one(r) for r in raws]
+            cfg = self._cfg()
+            results = [_decode_record(r, cfg, _np.random.RandomState(s))
+                       for r, s in zip(raws, seeds)]
         imgs = _np.stack([r[0] for r in results])
         labels = _np.asarray([r[1] for r in results], _np.float32)
         return DataBatch([nd.array(imgs, ctx=self._ctx)],

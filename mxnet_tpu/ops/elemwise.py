@@ -135,12 +135,24 @@ def _softrelu(x):
     return _jnp().logaddexp(x, 0.0)
 
 
+def _gelu_tanh_default():
+    """Knob-resolved default for gelu's ``approximate`` attr (ISSUE 7
+    satellite: the tanh form is the untried PROFILE.md MFU lever).
+    Resolved when an executable is first built for the attr set — same
+    trace-time-knob contract as MXNET_FUSED_ATTENTION; pass an explicit
+    ``approximate=`` (it is part of the jit cache key) to flip per call."""
+    from .. import config
+    return bool(config.get_int("MXNET_GELU_TANH", 0))
+
+
 @register("gelu")
-def _gelu(x, approximate=False):
+def _gelu(x, approximate=None):
     # exact erf form by default: the reference's gelu (leaky_relu.cc
-    # act_type='gelu') is 0.5x(1+erf(x/√2)), and Activation('gelu')
-    # already dispatches approximate=False — keep both paths identical
+    # act_type='gelu') is 0.5x(1+erf(x/√2)); approximate=True (or
+    # MXNET_GELU_TANH=1) selects 0.5x(1+tanh(√(2/π)(x+0.044715x³)))
     import jax
+    if approximate is None:
+        approximate = _gelu_tanh_default()
     return jax.nn.gelu(x, approximate=approximate)
 
 

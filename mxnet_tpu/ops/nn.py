@@ -184,7 +184,8 @@ def _activation(data, act_type="relu"):
 
 @register("LeakyReLU")
 def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
-                lower_bound=0.125, upper_bound=0.334):  # noqa: ARG001
+                lower_bound=0.125, upper_bound=0.334,
+                approximate=None):  # noqa: ARG001
     import jax
     jnp = _jnp()
     if act_type == "leaky":
@@ -198,7 +199,10 @@ def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
     if act_type == "selu":
         return jax.nn.selu(data)
     if act_type == "gelu":
-        return jax.nn.gelu(data, approximate=False)
+        if approximate is None:
+            from .elemwise import _gelu_tanh_default
+            approximate = _gelu_tanh_default()
+        return jax.nn.gelu(data, approximate=approximate)
     if act_type == "rrelu":
         mid = (lower_bound + upper_bound) / 2.0
         return jnp.where(data > 0, data, mid * data)

@@ -190,6 +190,29 @@ class MXIndexedRecordIO(MXRecordIO):
                 self._scan_cache = (offs - 8, offs, lens)  # sorted starts
         return self._scan_cache or None
 
+    def payload_spans(self, indices):
+        """Resolve keys → payload file spans for out-of-process readers
+        (the decode-pool workers pread records themselves — io/pipeline.py).
+
+        Returns ``(offsets, lengths)`` uint64/int64 arrays.  With the
+        native framing scan, offsets point at the payload bytes and
+        lengths are exact; without it, offsets are the RECORD start
+        positions (from the .idx sidecar) and lengths are -1 — the reader
+        must parse the 8-byte magic/length framing at the offset itself."""
+        from . import native
+        if self.writable:
+            raise MXNetError("payload_spans: file opened for writing")
+        positions = _np.asarray([self.idx[self.key_type(i)]
+                                 for i in indices], _np.uint64)
+        scan = self._native_scan() if native.native_available() else None
+        if scan is not None:
+            starts, offs, lens = scan
+            rows = _np.searchsorted(starts, positions)
+            ok = len(starts) > 0 and (rows < len(starts)).all()
+            if ok and (starts[rows] == positions).all():
+                return offs[rows], lens[rows].astype(_np.int64)
+        return positions, _np.full(len(positions), -1, _np.int64)
+
     def read_batch(self, indices):
         """Bulk-read many records by key in one native pass (the reference
         keeps this scan in C++ — dmlc recordio + iter_image_recordio_2.cc);
@@ -199,21 +222,14 @@ class MXIndexedRecordIO(MXRecordIO):
             # the python path raises here too; the native lane must not
             # silently read a half-flushed file
             raise MXNetError("read_batch: file opened for writing")
-        positions = _np.asarray([self.idx[self.key_type(i)]
-                                 for i in indices], _np.uint64)
-        scan = self._native_scan() if native.native_available() else None
-        if scan is not None:
-            starts, offs, lens = scan
-            rows = _np.searchsorted(starts, positions)
-            ok = len(starts) > 0 and (rows < len(starts)).all()
-            if ok and (starts[rows] == positions).all():
-                try:
-                    res = native.read_recordio_batch(
-                        self.uri, offs[rows], lens[rows])
-                    if res is not None:
-                        return res
-                except MXNetError:
-                    pass          # framing disagreement → fallback
+        offs, lens = self.payload_spans(indices)
+        if len(lens) and lens[0] >= 0:
+            try:
+                res = native.read_recordio_batch(self.uri, offs, lens)
+                if res is not None:
+                    return res
+            except MXNetError:
+                pass              # framing disagreement → fallback
         return [self.read_idx(self.key_type(i)) for i in indices]
 
 

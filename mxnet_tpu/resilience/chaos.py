@@ -39,12 +39,16 @@ from .policies import ResilienceError, TransientError
 __all__ = [
     "ChaosError", "ChaosTransientError", "ChaosWorkerDeath",
     "inject", "clear", "hit", "active", "sites", "fault_count", "SITES",
+    "arm_from_spec",
 ]
 
 # the documented site names (informational; hit() accepts any string so
-# downstream code can add sites without touching this module)
+# downstream code can add sites without touching this module).
+# ``io.decode`` fires INSIDE a decode-pool worker process (io/pipeline.py)
+# — arm it via the environment (workers re-arm from the parent's spec);
+# kind 'exit' there is a real worker kill.
 SITES = ("kvstore.allreduce", "dist.barrier", "dataloader.fetch",
-         "checkpoint.save", "trainer.step")
+         "checkpoint.save", "trainer.step", "io.decode")
 
 _M_FAULTS = _tel.counter(
     "mxnet_resilience_faults_injected_total",
@@ -169,13 +173,12 @@ def hit(site, **ctx):
             os._exit(1)
 
 
-def _arm_from_env():
-    """MXNET_CHAOS=1 + MXNET_CHAOS_SITES="site:kind[:times[:delay_s]],..."
-    arms faults at import, so chaos lanes need no code changes."""
-    if not config.get_bool("MXNET_CHAOS"):
-        return
-    spec = config.get("MXNET_CHAOS_SITES", "") or ""
-    for part in spec.split(","):
+def arm_from_spec(spec):
+    """Arm faults from a "site:kind[:times[:delay_s]],..." spec string —
+    the MXNET_CHAOS_SITES grammar, callable directly so decode-pool
+    workers can re-arm from the spec their PARENT resolved (a forkserver
+    child may inherit a stale environment)."""
+    for part in (spec or "").split(","):
         part = part.strip()
         if not part:
             continue
@@ -193,6 +196,14 @@ def _arm_from_env():
             warnings.warn(
                 f"ignoring malformed MXNET_CHAOS_SITES entry {part!r}: "
                 f"{exc}", stacklevel=2)
+
+
+def _arm_from_env():
+    """MXNET_CHAOS=1 + MXNET_CHAOS_SITES arms faults at import, so chaos
+    lanes need no code changes."""
+    if not config.get_bool("MXNET_CHAOS"):
+        return
+    arm_from_spec(config.get("MXNET_CHAOS_SITES", "") or "")
 
 
 _arm_from_env()

@@ -14,7 +14,7 @@ import sys
 import pytest
 
 
-def test_two_process_sync_kvstore():
+def _run_sync_kvstore(n, timeout=180):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, os.path.join(repo, "tools"))
     try:
@@ -30,11 +30,25 @@ def test_two_process_sync_kvstore():
     # process-lifetime state a fresh launch resets.
     env = {"MXNET_TPU_JIT_IMPERATIVE": "1", "MXNET_KVSTORE_TIMEOUT_S": "60"}
     for attempt in range(2):
-        codes = launch_local(2, [sys.executable, worker], env_extra=env,
-                             cpu_devices_per_worker=1, timeout=180)
-        if codes == [0, 0]:
+        codes = launch_local(n, [sys.executable, worker], env_extra=env,
+                             cpu_devices_per_worker=1, timeout=timeout)
+        if codes == [0] * n:
             break
-    assert codes == [0, 0], f"worker exit codes {codes}"
+    assert codes == [0] * n, f"worker exit codes {codes}"
+
+
+def test_two_process_sync_kvstore():
+    _run_sync_kvstore(2)
+
+
+@pytest.mark.slow
+def test_four_process_sync_kvstore():
+    """ISSUE 7 satellite (ROADMAP 4): the same exact-value body —
+    dense/structured allreduce, fused pushpull_list, 2-bit compression —
+    at n=4, proving the gloo mesh and the compression quantize/dequantize
+    wire format scale past the pairwise case.  Slow tier: four jax
+    processes rendezvousing over localhost gRPC on shared CPUs."""
+    _run_sync_kvstore(4, timeout=300)
 
 
 def test_launch_rejects_servers():

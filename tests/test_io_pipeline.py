@@ -1,0 +1,290 @@
+"""Multi-core decode pipeline tests (ISSUE 7): the shared-memory pooled
+decode path must be BIT-IDENTICAL to single-process decode (same records,
+same per-index augmentation RNG) through both front doors
+(``ImageRecordIter(decoder='pool')`` and the gluon ``DataLoader`` over a
+decode-aware dataset), and a killed decode worker must degrade through
+the ISSUE 3 ladder (in-process re-decode → pool rebuild → permanent
+single-process) without dropping or duplicating a record.
+
+Pool spin-up is forkserver-based (~1s per pipeline), so the suite shares
+one RecordIO pack and keeps epochs tiny.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import recordio, telemetry  # noqa: E402
+from mxnet_tpu.io.io import _mix_seed  # noqa: E402
+from mxnet_tpu.io.pipeline import _read_payload  # noqa: E402
+
+
+N_IMAGES, JPEG_SIZE, CROP, BATCH = 48, 96, 64, 8
+
+
+@pytest.fixture(scope="module")
+def rec_path(tmp_path_factory):
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "benchmark"))
+    try:
+        from io_bench import make_dataset
+    finally:
+        sys.path.pop(0)
+    root = tmp_path_factory.mktemp("io_pipeline")
+    return make_dataset(str(root / "pack"), N_IMAGES, JPEG_SIZE)
+
+
+def _make_iter(rec, threads, seed=13, **kw):
+    return mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, CROP, CROP), batch_size=BATCH,
+        shuffle=True, rand_crop=True, rand_mirror=True, seed=seed,
+        preprocess_threads=threads, decoder="pool", ctx=mx.cpu(),
+        mean_r=123.68, mean_g=116.78, mean_b=103.94,
+        std_r=58.4, std_g=57.1, std_b=57.4, **kw)
+
+
+def _epoch(it):
+    return [(b.data[0].asnumpy(), b.label[0].asnumpy()) for b in it]
+
+
+def _assert_epochs_equal(ref, got):
+    assert len(ref) == len(got) > 0
+    for (rd, rl), (gd, gl) in zip(ref, got):
+        np.testing.assert_array_equal(rd, gd)
+        np.testing.assert_array_equal(rl, gl)
+
+
+# ---------------------------------------------------------------------------
+# unit pieces
+# ---------------------------------------------------------------------------
+
+def test_mix_seed_deterministic_and_spread():
+    a = [_mix_seed(7, k) for k in range(256)]
+    assert a == [_mix_seed(7, k) for k in range(256)]  # pure function
+    assert len(set(a)) == 256                          # no collisions here
+    assert all(0 <= s < 2 ** 32 for s in a)
+    assert _mix_seed(7, 0) != _mix_seed(8, 0)          # seed matters
+
+
+def test_payload_spans_match_read_idx(rec_path):
+    """Workers pread spans the parent resolved; the bytes they see must be
+    exactly what read_idx returns (both native-scan and idx-fallback
+    shapes of payload_spans)."""
+    idx_path = os.path.splitext(rec_path)[0] + ".idx"
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    keys = list(rec.keys)[:5]
+    offs, lens = rec.payload_spans(keys)
+    fd = os.open(rec_path, os.O_RDONLY)
+    try:
+        for k, off, length in zip(keys, offs, lens):
+            assert _read_payload(fd, int(off), int(length)) == \
+                rec.read_idx(k)
+        # the scanner-less shape: offsets are RECORD starts (the .idx
+        # sidecar positions) and the worker parses the 8-byte framing
+        # itself — must yield the same payload bytes
+        for k in keys:
+            start = int(rec.idx[rec.key_type(k)])
+            assert _read_payload(fd, start, -1) == rec.read_idx(k)
+    finally:
+        os.close(fd)
+        rec.close()
+
+
+def test_io_pool_knob_off(rec_path, monkeypatch):
+    """MXNET_IO_POOL=0 forces in-process decode: no pipeline is built even
+    at preprocess_threads>1 with decoder='pool'."""
+    monkeypatch.setenv("MXNET_IO_POOL", "0")
+    it = _make_iter(rec_path, threads=2)
+    batches = _epoch(it)
+    assert it._pipeline is None and len(batches) == N_IMAGES // BATCH
+    it.close()
+
+
+# ---------------------------------------------------------------------------
+# bit-identity (the tentpole acceptance contract)
+# ---------------------------------------------------------------------------
+
+def test_pooled_bit_identical_to_single_process(rec_path):
+    """Pooled epochs — including a mid-epoch reset — replay the exact
+    bytes of single-process decode: same shuffle order, same crop/mirror
+    draws, same labels, across epochs."""
+    single = _make_iter(rec_path, threads=1)
+    pooled = _make_iter(rec_path, threads=2)
+    try:
+        e0 = _epoch(single)
+        _assert_epochs_equal(e0, _epoch(pooled))
+        # epoch 2 reshuffles from the epoch-mixed seed; must still agree
+        single.reset()
+        pooled.reset()
+        e1 = _epoch(single)
+        assert not np.array_equal(e0[0][1], e1[0][1]) or len(e0) == 1
+        _assert_epochs_equal(e1, _epoch(pooled))
+        # mid-epoch reset: consume part of epoch 3 pooled, reset both,
+        # epoch 4 must be identical again (drain() discards cleanly)
+        single.reset()
+        pooled.reset()
+        next(pooled)
+        single.reset()
+        pooled.reset()
+        _assert_epochs_equal(_epoch(single), _epoch(pooled))
+    finally:
+        single.close()
+        pooled.close()
+
+
+def test_pooled_decode_telemetry(rec_path):
+    """The decode counter/histogram observe pooled work (queue gauge and
+    decode seconds ride the same flag)."""
+    telemetry.enable()
+    try:
+        dec = telemetry.REGISTRY.get("mxnet_io_decoded_images_total")
+        before = dec.value
+        it = _make_iter(rec_path, threads=2)
+        n = sum(d.shape[0] for d, _ in _epoch(it))
+        it.close()
+        assert dec.value - before >= n
+    finally:
+        telemetry.disable()
+
+
+def test_dataloader_decode_pool_bit_identical(rec_path):
+    """The gluon DataLoader routes a decode-aware dataset through the
+    shared-memory pool when num_workers>0 — batches identical to
+    num_workers=0, across two epochs of the same loader (pipeline
+    persists; the generic pickle pool is never built)."""
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.vision.datasets import DecodedImageRecordDataset
+    ds = DecodedImageRecordDataset(
+        rec_path, (3, CROP, CROP), rand_crop=True, rand_mirror=True,
+        mean=(123.68, 116.78, 103.94), std=(58.4, 57.1, 57.4), seed=5)
+    dl0 = DataLoader(ds, batch_size=BATCH, shuffle=False, num_workers=0)
+    dl2 = DataLoader(ds, batch_size=BATCH, shuffle=False, num_workers=2)
+    try:
+        assert dl2._use_decode_pool and dl2._pool is None
+        ref = [(d.asnumpy(), l.asnumpy()) for d, l in dl0]
+        _assert_epochs_equal(ref, [(d.asnumpy(), l.asnumpy())
+                                   for d, l in dl2])
+        _assert_epochs_equal(ref, [(d.asnumpy(), l.asnumpy())
+                                   for d, l in dl2])  # epoch 2, same pipe
+    finally:
+        dl0._shutdown_pool()
+        dl2._shutdown_pool()
+
+
+def test_dataloader_nested_iteration_correct(rec_path):
+    """Nested iteration of one decode-pool DataLoader must not corrupt
+    either stream: the pipeline is a single ordered stream, so the inner
+    generator decodes in-process while the outer keeps its schedule —
+    both yield exactly the single-process batches."""
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.vision.datasets import DecodedImageRecordDataset
+    ds = DecodedImageRecordDataset(
+        rec_path, (3, CROP, CROP), rand_crop=True, rand_mirror=True,
+        mean=(123.68, 116.78, 103.94), std=(58.4, 57.1, 57.4), seed=5)
+    dl0 = DataLoader(ds, batch_size=BATCH, shuffle=False, num_workers=0)
+    dl2 = DataLoader(ds, batch_size=BATCH, shuffle=False, num_workers=2)
+    try:
+        ref = [(d.asnumpy(), l.asnumpy()) for d, l in dl0]
+        outer, inner = [], []
+        for d, l in dl2:
+            outer.append((d.asnumpy(), l.asnumpy()))
+            if len(outer) == 1:   # nest a full epoch mid-outer-epoch
+                inner = [(di.asnumpy(), li.asnumpy()) for di, li in dl2]
+        _assert_epochs_equal(ref, outer)
+        _assert_epochs_equal(ref, inner)
+        assert dl2._use_decode_pool    # no failure episodes were burned
+    finally:
+        dl0._shutdown_pool()
+        dl2._shutdown_pool()
+
+
+def test_dataset_getitem_matches_iterator_decode(rec_path):
+    """DecodedImageRecordDataset[i] is the same pure decode function the
+    pool runs — spot-check a sample against a manual seeded decode."""
+    from mxnet_tpu.gluon.data.vision.datasets import DecodedImageRecordDataset
+    from mxnet_tpu.io.io import _decode_record
+    ds = DecodedImageRecordDataset(
+        rec_path, (3, CROP, CROP), rand_crop=True, rand_mirror=True,
+        seed=9)
+    img, label = ds[3]
+    raw = ds._rec.read_idx(ds._keys[3])
+    img2, label2 = _decode_record(
+        raw, ds._cfg, np.random.RandomState(ds._sample_seed(3)))
+    np.testing.assert_array_equal(img, img2)
+    assert label == label2
+
+
+def test_steady_state_epoch_no_retrace(rec_path):
+    """ISSUE 7 acceptance: the pooled path hands the consumer fixed-shape
+    private arrays, so a steady-state epoch feeding a jitted op performs
+    ZERO XLA compilations (analysis.runtime.no_retrace — the dynamic GC02
+    twin) — batch shapes never churn the jit cache."""
+    from mxnet_tpu.analysis import runtime
+    it = _make_iter(rec_path, threads=2)
+    try:
+        for b in it:                       # warm-up epoch: traces compile
+            (b.data[0] * 2.0).asnumpy()
+        it.reset()
+        with runtime.no_retrace():
+            for b in it:                   # steady state: cache hits only
+                (b.data[0] * 2.0).asnumpy()
+    finally:
+        it.close()
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder (chaos worker-kill — ISSUE 3 semantics)
+# ---------------------------------------------------------------------------
+
+def test_chaos_worker_kill_degrades_without_record_loss(rec_path,
+                                                        monkeypatch):
+    """A decode worker hard-killed mid-epoch (chaos io.decode:exit — real
+    os._exit in the worker) rides the ladder: affected chunks re-decode
+    in-process from the same seeds, the pool is rebuilt, and the epoch's
+    batches stay bit-identical to single-process — nothing dropped,
+    nothing duplicated."""
+    single = _make_iter(rec_path, threads=1)
+    ref = _epoch(single)
+    single.close()
+    # env-armed so the POOL WORKERS arm it (parent stays clean); each
+    # fresh worker kills itself on its first chunk, so every pool
+    # generation fails and the ladder is walked end to end
+    monkeypatch.setenv("MXNET_CHAOS", "1")
+    monkeypatch.setenv("MXNET_CHAOS_SITES", "io.decode:exit:1")
+    pooled = _make_iter(rec_path, threads=2)
+    try:
+        with pytest.warns(UserWarning, match="io decode pool"):
+            got = _epoch(pooled)
+        _assert_epochs_equal(ref, got)
+        assert pooled._pipeline._failures >= 1
+    finally:
+        pooled.close()
+
+
+def test_chaos_permanent_degradation_completes(rec_path, monkeypatch):
+    """Unbounded worker kills exhaust MXNET_DATALOADER_RETRIES and the
+    pipeline degrades PERMANENTLY to single-process decode — the epoch
+    (and the next one) still completes bit-identically."""
+    single = _make_iter(rec_path, threads=1)
+    ref0 = _epoch(single)
+    single.reset()
+    ref1 = _epoch(single)
+    single.close()
+    monkeypatch.setenv("MXNET_CHAOS", "1")
+    monkeypatch.setenv("MXNET_CHAOS_SITES", "io.decode:exit:0")
+    monkeypatch.setenv("MXNET_DATALOADER_RETRIES", "1")
+    pooled = _make_iter(rec_path, threads=2)
+    try:
+        with pytest.warns(UserWarning, match="degrading permanently"):
+            got0 = _epoch(pooled)
+        _assert_epochs_equal(ref0, got0)
+        assert pooled._pipeline._permanent
+        pooled.reset()   # epoch 2 runs fully in-process, no pool attempt
+        _assert_epochs_equal(ref1, _epoch(pooled))
+    finally:
+        pooled.close()

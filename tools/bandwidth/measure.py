@@ -40,6 +40,13 @@ def _maybe_init_distributed():
     # TPU would fake the multi-device measurement
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
+        # multi-process CPU collectives need a host implementation,
+        # configured BEFORE backend init (the ISSUE 3 dist-worker fix;
+        # without it every cross-process psum raises)
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            pass  # older jaxlib without gloo
     coord = os.environ.get("MXNET_DIST_COORDINATOR")
     if coord:
         try:
