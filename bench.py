@@ -474,6 +474,15 @@ def _orchestrate(name):
                                "MXNET_BENCH_SEQLEN": "2048",
                                "MXNET_BENCH_BATCH": "4",
                                "MXNET_BENCH_SCAN_STEPS": "8"}),
+            # the NARROW llama row (VERDICT weak #4): 8L/1024u stays in
+            # lane extras every round so the headline can't quietly ride
+            # config width — the 2048u lane fills the MXU, this one
+            # documents what the small-matmul regime still costs
+            ("llama_8L1024", {"MXNET_BENCH_MODEL": "llama_longseq",
+                              "MXNET_BENCH_LLAMA_ARCH": "8,1024,2752,16,8,0",
+                              "MXNET_BENCH_SEQLEN": "2048",
+                              "MXNET_BENCH_BATCH": "8",
+                              "MXNET_BENCH_SCAN_STEPS": "8"}),
             ("resnet50", {"MXNET_BENCH_MODEL": "resnet50_v1",
                           "MXNET_BENCH_BATCH": "64",
                           "MXNET_BENCH_SCAN_STEPS": "32"}),

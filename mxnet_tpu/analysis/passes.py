@@ -65,6 +65,13 @@ HOT_PATHS = {
     "io/pipeline.py": {"next_batch", "_assemble_loop", "_collect", "_pump",
                        "_issue", "_inline_chunk", "_decode_chunk",
                        "_read_payload", "_attach_slab"},
+    # sharding engine (ISSUE 8): rule matching/resolution runs at trace
+    # time but sits on the TrainStep dispatch path, and the per-step
+    # __call__/run bodies must stay host-sync-free
+    "sharding.py": None,
+    "parallel.py": {"__call__", "run", "_param_sharding",
+                    "_shardings", "_data_shardings", "_build",
+                    "_build_multi"},
 }
 
 # GC05 additionally audits these (they sit on the per-batch/per-call path

@@ -78,8 +78,46 @@ class CheckpointManager:
     # -- helpers ------------------------------------------------------------
     @staticmethod
     def _net_arrays(net):
-        return {name: p.data()._data
-                for name, p in net.collect_params().items()}
+        """Param name -> array tree for the save.
+
+        Mesh-sharded params (a TrainStep with partition rules leaves
+        jax.Arrays carrying NamedShardings) round-trip two ways
+        (MXNET_CHECKPOINT_SHARDED):
+         - 0 (default, gather-on-save): sharded arrays gather to one
+           host array first — the checkpoint is topology-free and
+           restores on any mesh (or none);
+         - 1 (sharded-save): jax.Arrays pass straight through and orbax
+           writes shards in parallel per host — the pod-scale path.
+        Restore is identical either way (StandardRestore yields host
+        arrays; the next sharded step re-places them per its rules).
+        """
+        import numpy as np
+        sharded_save = bool(config.get_int("MXNET_CHECKPOINT_SHARDED", 0))
+        import jax
+        multiproc = jax.process_count() > 1
+        out = {}
+        for name, p in net.collect_params().items():
+            arr = p.data()._data
+            sh = getattr(arr, "sharding", None)
+            if sh is not None:
+                if not sharded_save and not getattr(
+                        sh, "is_fully_replicated", True):
+                    if not arr.is_fully_addressable:
+                        # a sharded GLOBAL array: np.asarray would raise
+                        # ("spans non-addressable devices") — every host
+                        # gathers the full value before the numpy copy
+                        from jax.experimental import multihost_utils
+                        arr = multihost_utils.process_allgather(
+                            arr, tiled=True)
+                    arr = np.asarray(arr)
+                elif multiproc and arr.is_fully_addressable:
+                    # a host-local array in a multi-process world (the
+                    # dist-kvstore replica case): orbax cannot serialize
+                    # it as a jax.Array — every rank holds the same
+                    # values, so the primary writes the host copy
+                    arr = np.asarray(arr)
+            out[name] = arr
+        return out
 
     # -- commit manifest (atomicity layer) ----------------------------------
     def _read_manifest(self):

@@ -125,6 +125,20 @@ KNOWN_VARS = {
     "MXNET_CHECKPOINT_KEEP": (
         "3", int,
         "How many step checkpoints mx.checkpoint.CheckpointManager retains."),
+    "MXNET_CHECKPOINT_SHARDED": (
+        "0", int,
+        "If 1, mesh-sharded params save as sharded jax.Arrays (orbax "
+        "writes shards in parallel per host — the pod-scale path); 0 "
+        "(default) gathers them to host arrays first, making the "
+        "checkpoint topology-free (restores on any mesh or none)."),
+    # GSPMD sharding engine (ISSUE 8: mxnet_tpu.sharding)
+    "MXNET_SHARDING_SKIP_ALLREDUCE": (
+        "1", int,
+        "If 1 (default), gluon.Trainer skips the local/device kvstore "
+        "gradient reduction for params flagged Parameter.mesh_reduced "
+        "(a mesh-jitted step already psum'd their grads — reducing again "
+        "would double-count); dist stores always reduce. 0 restores the "
+        "unconditional reduction."),
     # resilience family (ISSUE 3: mx.resilience)
     "MXNET_KVSTORE_TIMEOUT_S": (
         "300", float,

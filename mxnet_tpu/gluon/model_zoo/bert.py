@@ -150,17 +150,11 @@ def bert_model(name="bert_12_768_12", vocab_size=30522, max_length=512,
 
 
 def apply_tp_shardings(model, axis="tp"):
-    """Annotate megatron-style tensor-parallel shardings on a BERTModel.
-
-    Column-parallel (shard the output features): attn qkv, ffn_1.
-    Row-parallel (shard the input features): attn proj, ffn_2.
-    Dense weights are (out_features, in_features).
-    """
-    for name, p in model.collect_params().items():
-        if p.shape is None or len(p.shape) != 2:
-            continue
-        if "attn_qkv_weight" in name or "ffn1_weight" in name:
-            p.sharding = (axis, None)
-        elif "attn_proj_weight" in name or "ffn2_weight" in name:
-            p.sharding = (None, axis)
+    """Annotate megatron-style tensor-parallel shardings on a BERTModel —
+    delegates to the declarative rule pack (mxnet_tpu.sharding
+    .bert_rules): attn qkv + ffn_1 column-parallel, attn proj + ffn_2
+    row-parallel, word/decoder tables over the vocab dim.  Dense weights
+    are (out_features, in_features)."""
+    from ... import sharding as _sh
+    _sh.apply_rules(model, _sh.bert_rules(tp=axis))
     return model

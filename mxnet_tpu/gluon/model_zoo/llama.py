@@ -188,23 +188,11 @@ def llama_model(name="llama_tiny", vocab_size=32000, **kwargs):
 
 
 def apply_tp_shardings(model, axis="tp"):
-    """Megatron tensor-parallel annotation for a LlamaModel.
-
-    Column-parallel (shard out-features): q/k/v, gate, up, lm_head.
-    Row-parallel (shard in-features): o_proj, down.
-    Embedding table shards over the vocab dim.
-    Dense weights are (out_features, in_features).
-    """
-    for name, p in model.collect_params().items():
-        if p.shape is None or len(p.shape) != 2:
-            continue
-        if name.endswith("tok_weight"):          # before q/k/v suffixes:
-            p.sharding = (axis, None)            # 'tok_weight' ends with
-            continue                             # 'k_weight' too
-        if any(name.endswith(t) for t in ("q_weight", "k_weight",
-                                          "v_weight", "gate_weight",
-                                          "up_weight", "lm_head_weight")):
-            p.sharding = (axis, None)
-        elif any(name.endswith(t) for t in ("o_weight", "down_weight")):
-            p.sharding = (None, axis)
+    """Megatron tensor-parallel annotation for a LlamaModel — delegates
+    to the declarative rule pack (mxnet_tpu.sharding.llama_rules):
+    q/k/v + gate/up + lm_head column-parallel, o_proj + down
+    row-parallel, the token table over the vocab dim, norms replicated.
+    Dense weights are (out_features, in_features)."""
+    from ... import sharding as _sh
+    _sh.apply_rules(model, _sh.llama_rules(tp=axis))
     return model

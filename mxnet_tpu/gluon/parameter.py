@@ -45,6 +45,10 @@ class Parameter:
         self.stype = stype
         self.grad_stype = grad_stype
         self.sharding = sharding  # TPU: PartitionSpec axes hint for pjit
+        # set by mxnet_tpu.sharding when a mesh computation (TrainStep)
+        # already reduces this param's gradient: Trainer then skips the
+        # (double-counting) local kvstore allreduce for it
+        self.mesh_reduced = False
         self._data = None         # canonical buffer (ctx_list[0] replica)
         self._data_list = None    # one replica per ctx (multi-device DP)
         self._grad = None

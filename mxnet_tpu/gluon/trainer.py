@@ -209,7 +209,22 @@ class Trainer:
             if self._update_on_kvstore:
                 # per-key: the store runs the optimizer inside push and pull
                 # broadcasts the updated WEIGHTS (no fused analog — the
-                # fusion layer reduces gradients only)
+                # fusion layer reduces gradients only).  mesh_reduced
+                # params cannot be honored here: skipping the push would
+                # skip the store's optimizer update too, and pushing
+                # double-counts the mesh's psum — fail loudly.
+                from .. import config as _cfg
+                if _cfg.get_int("MXNET_SHARDING_SKIP_ALLREDUCE", 1) \
+                        and any(p.mesh_reduced for p in self._params
+                                if p.grad_req != "null"):
+                    raise MXNetError(
+                        "update_on_kvstore=True cannot honor "
+                        "Parameter.mesh_reduced: the store reduces inside "
+                        "push, double-counting gradients the mesh already "
+                        "reduced.  Use update_on_kvstore=False, clear the "
+                        "mesh_reduced flags, or set "
+                        "MXNET_SHARDING_SKIP_ALLREDUCE=0 to accept the "
+                        "unconditional reduction.")
                 for i, p in enumerate(self._params):
                     if p.grad_req == "null":
                         continue
@@ -224,13 +239,31 @@ class Trainer:
             # call; it buckets dense uncompressed keys into flat buffers
             # (kvstore/fusion.py) and falls back per key for the rest,
             # bit-identically
+            #
+            # sharding engine (ISSUE 8): params whose gradients a mesh
+            # computation already reduced (Parameter.mesh_reduced — GSPMD
+            # psum over the data axis inside the jit) skip the LOCAL
+            # reduction here, which would double-count over the same
+            # devices.  Dist stores still reduce everything: the mesh
+            # spans one process, the dist psum spans the job.
+            from .. import config as _cfg
+            skip_reduced = (
+                not hasattr(self._kvstore, "_ensure_dist")
+                and _cfg.get_int("MXNET_SHARDING_SKIP_ALLREDUCE", 1))
             keys, vals = [], []
+            n_skipped = 0
             for i, p in enumerate(self._params):
                 if p.grad_req == "null":
+                    continue
+                if skip_reduced and p.mesh_reduced:
+                    n_skipped += 1
                     continue
                 grads = p.list_grad()
                 keys.append(i)
                 vals.append(grads if len(grads) > 1 else grads[0])
+            if n_skipped and _ttrace._ENABLED:
+                from .. import sharding as _sh
+                _sh._M_SKIPPED_ALLREDUCE.inc(n_skipped)
             if not keys:
                 return
             if allow_flat and self._fused_kind() is not None \

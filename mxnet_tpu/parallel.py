@@ -33,7 +33,20 @@ import numpy as _np
 from .base import MXNetError
 from .context import Context
 from . import ndarray as nd
+from . import telemetry as _tel
+from .telemetry import tracer as _ttrace
 from .ndarray.ndarray import NDArray
+
+# sharded-step observability (ISSUE 8 satellite): dispatches vs retraces —
+# a steady-state sharded loop must show dispatches growing while retraces
+# stay flat (the runtime twin of graftcheck GC02 for the mesh path)
+_M_STEP_DISPATCHES = _tel.counter(
+    "mxnet_sharding_step_dispatches_total",
+    "Sharded TrainStep dispatches (one per __call__/run invocation).")
+_M_RETRACES = _tel.counter(
+    "mxnet_sharding_retraces_total",
+    "TrainStep executable builds (trace+compile); growth at steady state "
+    "is a retrace bug — see graftcheck GC02.")
 
 __all__ = ["DeviceMesh", "make_mesh", "data_parallel_ctxs", "TrainStep",
            "allreduce", "allgather", "current_mesh", "set_mesh",
@@ -115,12 +128,23 @@ class DeviceMesh:
 
     def sharded(self, *spec):
         """NamedSharding with the given per-dim axis assignment, e.g.
-        mesh.sharded('dp') shards dim0 over the data axis."""
+        mesh.sharded('dp') shards dim0 over the data axis; an entry may
+        also be a tuple of axes ('dp', 'fsdp') sharding one dim over
+        several mesh axes (true N-axis layouts)."""
         jax = _jax()
-        return jax.sharding.NamedSharding(self.mesh,
-                                          jax.sharding.PartitionSpec(*spec))
+        return jax.sharding.NamedSharding(self.mesh, self.spec(*spec))
 
     def spec(self, *spec):
+        """PartitionSpec over THIS mesh's axes — an entry naming an axis
+        the mesh doesn't carry is a layout typo and raises (use
+        sharding.resolve_spec for the degrade-to-replicated behavior)."""
+        for entry in spec:
+            entry = entry if isinstance(entry, (tuple, list)) else (entry,)
+            for a in entry:
+                if a is not None and a not in self.axis_names:
+                    raise MXNetError(
+                        f"mesh {self!r} has no axis {a!r}; axes are "
+                        f"{self.axis_names}")
         return _jax().sharding.PartitionSpec(*spec)
 
     @property
@@ -335,13 +359,23 @@ class TrainStep:
     Per-step scalars (t, per-param lr incl. schedules and Adam bias
     correction) enter as *traced* arguments, so the step compiles once.
 
+    Declarative layouts (the GSPMD sharding engine, mxnet_tpu.sharding):
+    ``partition_rules`` is an ordered ``(regex, spec)`` list matched
+    against the net's param names at resolve time — matched params (and
+    their same-shaped optimizer state: adam m/v, momentum, fp32 masters)
+    carry the resolved NamedSharding through the jit, unmatched params
+    replicate bit-identically.  ``data_spec`` names the batch layout per
+    dim (default ``('dp',)``): e.g. ``('dp', 'sp')`` shards (B, L) token
+    batches over data AND sequence axes — the dp×tp×sp 3-axis recipe.
+
     Equivalent reference machinery: CachedOp::Forward/Backward +
     Trainer.step + CommDevice reduce + fused optimizer kernels, all in one
     XLA program.
     """
 
     def __init__(self, net, loss_fn, optimizer, optimizer_params=None,
-                 mesh=None, donate=True):
+                 mesh=None, donate=True, partition_rules=None,
+                 data_spec=None):
         from . import optimizer as opt
         self.net = net
         self.loss_fn = loss_fn
@@ -351,10 +385,26 @@ class TrainStep:
             self.optimizer = optimizer
         self.mesh = mesh or current_mesh() or make_mesh()
         self._donate = donate
+        self._rules = partition_rules
+        if data_spec is not None:
+            data_spec = tuple(data_spec)
+            for entry in data_spec:
+                axes = entry if isinstance(entry, (tuple, list)) \
+                    else (entry,)
+                for a in axes:
+                    if a is not None and a not in self.mesh.axis_names:
+                        raise MXNetError(
+                            f"data_spec {data_spec} names axis {a!r} the "
+                            f"mesh {self.mesh!r} does not carry")
+        self._data_spec = data_spec
+        self._param_specs = None  # name -> logical spec (partition_rules)
+        self._p_sh = None         # resolved per-param NamedShardings
+        self._s_sh = None         # resolved per-state NamedShardings
         self._params = None       # all params (incl. aux) in fixed order
         self._trainable = None
         self._states = None       # index -> optimizer state (NDArray tree)
         self._state_nds = None    # flattened state NDArrays
+        self._state_owner = None  # trainable index owning each state NDArray
         self._fused = None        # (kind, bucket plan) — optimizer_fusion
         self._cache = {}
         self._cache_epoch = None
@@ -385,13 +435,24 @@ class TrainStep:
             self.net(data_nd)  # finish deferred init
         self._params = list(self.net.collect_params().values())
         self._trainable = [p for p in self._params if p.grad_req != "null"]
+        if self._rules is not None:
+            # declarative layout: resolve the rule set against the named
+            # param tree ONCE (first-match-wins, scalars + unmatched
+            # replicate) — _param_sharding then reads these specs
+            from . import sharding as _sh
+            self._param_specs = _sh.match_partition_rules(
+                self._rules, {p.name: p for p in self._params})
         self._states = {
             i: self.optimizer.create_state_multi_precision(i, p.data())
             for i, p in enumerate(self._trainable)}
-        flat = []
+        flat, owners = [], []
         for i in range(len(self._trainable)):
+            n0 = len(flat)
             self._flat_state(self._states[i], flat)
+            owners.extend([i] * (len(flat) - n0))
         self._state_nds = flat
+        self._state_owner = owners
+        self._p_sh = self._s_sh = None  # re-resolve shardings next use
         # fused optimizer (optimizer_fusion): plan the dtype buckets NOW
         # (host side, before any tracing); raw() then updates through the
         # fused math inline — the same formulas the imperative Trainer
@@ -401,15 +462,64 @@ class TrainStep:
         self._fused = _fus.plan_trainstep(self.optimizer, self._trainable)
 
     def _param_sharding(self, p):
-        if p.sharding:
-            # hints name logical axes ('tp', 'ep', …); axes the current mesh
-            # doesn't carry degrade to unsharded dims so the same model runs
-            # on smaller meshes unchanged
-            spec = tuple(a if a in self.mesh.axis_names else None
-                         for a in p.sharding)
-            if any(a is not None for a in spec):
-                return self.mesh.sharded(*spec)
+        """Resolved NamedSharding for one param.  With partition_rules
+        the rule mapping is AUTHORITATIVE: a matched-() or unmatched
+        param replicates (the bit-identity contract) — construction-time
+        Parameter.sharding hints do not resurrect under it.  Without
+        rules the hint applies.  Either way axes the mesh doesn't carry
+        and indivisible dims degrade to unsharded so the same layout
+        runs on smaller meshes unchanged."""
+        from . import sharding as _sh
+        if self._param_specs is not None:
+            spec = self._param_specs.get(p.name, ())
+        else:
+            spec = p.sharding
+        if spec:
+            return _sh.resolve_spec(spec, self.mesh, shape=p.shape)[0]
+        # under declared rules an empty spec (scalar, matched-() rule,
+        # unmatched) is replication too — count it so resolved+fallback
+        # covers every param and a missing-rule regression shows up in
+        # the coverage numbers.  A rule-less TrainStep declares no
+        # layout and stays out of the coverage telemetry entirely.
+        if _ttrace._ENABLED and self._param_specs is not None:
+            _sh._M_FALLBACK.inc()
         return self.mesh.replicated()
+
+    def _shardings(self):
+        """(per-param, per-state) NamedShardings, resolved ONCE per
+        resolve — the mxnet_sharding_{resolved,fallback}_params_total
+        counters then count each param exactly once (layout coverage),
+        and the per-step dispatch path reuses the objects instead of
+        rebuilding them.  Optimizer state rides its owner param's layout
+        when the shapes match (adam m/v, momenta, fp32 masters are
+        elementwise over the weight), else replicates."""
+        if self._p_sh is None:
+            self._p_sh = tuple(self._param_sharding(p)
+                               for p in self._params)
+            by_param = {id(p): sh
+                        for p, sh in zip(self._params, self._p_sh)}
+            repl = self.mesh.replicated()
+            out = []
+            for s, i in zip(self._state_nds, self._state_owner):
+                p = self._trainable[i]
+                if tuple(s.shape) == tuple(p.shape or ()):
+                    out.append(by_param[id(p)])
+                else:
+                    out.append(repl)
+            self._s_sh = tuple(out)
+        return self._p_sh, self._s_sh
+
+    def _data_shardings(self, data_ndim, label_ndim, stacked=False):
+        """(data, label) NamedShardings from data_spec (default: dim0
+        over the mesh's first axis).  The spec clips to each operand's
+        rank — a (B,) label under data_spec ('dp', 'sp') shards over dp
+        only — and stacked run() batches get a leading unsharded steps
+        dim."""
+        spec = self._data_spec if self._data_spec is not None \
+            else (self.mesh.axis_names[0],)
+        lead = (None,) if stacked else ()
+        return (self.mesh.sharded(*(lead + spec[:data_ndim])),
+                self.mesh.sharded(*(lead + spec[:label_ndim])))
 
     # -- trace ----------------------------------------------------------------
     def _make_raw(self):
@@ -478,17 +588,17 @@ class TrainStep:
         import jax
         raw = self._make_raw()
         repl = self.mesh.replicated()
-        dp = self.mesh.axis_names[0]
-        batch_sh = self.mesh.sharded(dp)
-        p_sh = tuple(self._param_sharding(p) for p in self._params)
-        s_sh = tuple(repl for _ in self._state_nds)
-        in_sh = (repl, repl, repl, repl, p_sh, s_sh, batch_sh, batch_sh)
+        d_sh, l_sh = self._data_shardings(len(data.shape), len(label.shape))
+        p_sh, s_sh = self._shardings()
+        in_sh = (repl, repl, repl, repl, p_sh, s_sh, d_sh, l_sh)
         out_sh = (p_sh, s_sh, repl)
         donate = (4, 5) if self._donate else ()
+        if _ttrace._ENABLED:
+            _M_RETRACES.inc()
         return jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
                        donate_argnums=donate)
 
-    def _build_multi(self, stacked):
+    def _build_multi(self, stacked, data_ndim, label_ndim):
         """K steps fused into ONE XLA program via lax.scan.
 
         Amortizes per-dispatch host/RPC latency over K steps — on TPU the
@@ -517,14 +627,15 @@ class TrainStep:
             return p, s, losses
 
         repl = self.mesh.replicated()
-        dp = self.mesh.axis_names[0]
-        p_sh = tuple(self._param_sharding(p) for p in self._params)
-        s_sh = tuple(repl for _ in self._state_nds)
-        batch_sh = self.mesh.sharded(None, dp) if stacked \
-            else self.mesh.sharded(dp)
-        in_sh = (repl, repl, repl, repl, p_sh, s_sh, batch_sh, batch_sh)
+        p_sh, s_sh = self._shardings()
+        lead = 1 if stacked else 0
+        d_sh, l_sh = self._data_shardings(data_ndim - lead,
+                                          label_ndim - lead, stacked=stacked)
+        in_sh = (repl, repl, repl, repl, p_sh, s_sh, d_sh, l_sh)
         out_sh = (p_sh, s_sh, repl)
         donate = (4, 5) if self._donate else ()
+        if _ttrace._ENABLED:
+            _M_RETRACES.inc()
         return jax.jit(raw_multi, in_shardings=in_sh, out_shardings=out_sh,
                        donate_argnums=donate)
 
@@ -555,7 +666,8 @@ class TrainStep:
                    (tuple(label.shape), str(label.dtype)))
         fn = self._cache.get(key_sig)
         if fn is None:
-            fn = self._build_multi(stacked)
+            fn = self._build_multi(stacked, len(data.shape),
+                                   len(label.shape))
             self._cache[key_sig] = fn
 
         # host-side bookkeeping for every step up front; per-step scalars
@@ -575,15 +687,20 @@ class TrainStep:
         rescale = _np.float32(self.optimizer.rescale_grad)
         keys = jax.random.split(_rnd.get_key(), steps)
 
-        batch_sh = self.mesh.sharded(None, self.mesh.axis_names[0]) \
-            if stacked else self.mesh.sharded(self.mesh.axis_names[0])
-        d = jax.device_put(data._data, batch_sh)
-        l = jax.device_put(label._data, batch_sh)
-        p_vals = tuple(jax.device_put(p._data._data, self._param_sharding(p))
-                       for p in self._params)
-        s_vals = tuple(jax.device_put(s._data, self.mesh.replicated())
-                       for s in self._state_nds)
+        lead = 1 if stacked else 0
+        d_sh, l_sh = self._data_shardings(len(data.shape) - lead,
+                                          len(label.shape) - lead,
+                                          stacked=stacked)
+        d = jax.device_put(data._data, d_sh)
+        l = jax.device_put(label._data, l_sh)
+        p_sh, s_sh = self._shardings()
+        p_vals = tuple(jax.device_put(p._data._data, sh)
+                       for p, sh in zip(self._params, p_sh))
+        s_vals = tuple(jax.device_put(s._data, sh)
+                       for s, sh in zip(self._state_nds, s_sh))
 
+        if _ttrace._ENABLED:
+            _M_STEP_DISPATCHES.inc()
         new_p, new_s, losses = fn(keys, ts, lr_vecs, rescale, p_vals, s_vals,
                                   d, l)
         for p, v in zip(self._params, new_p):
@@ -625,14 +742,17 @@ class TrainStep:
         from . import random as _rnd
         key = _rnd.get_key()
 
-        batch_sh = self.mesh.sharded(self.mesh.axis_names[0])
-        d = jax.device_put(data._data, batch_sh)
-        l = jax.device_put(label._data, batch_sh)
-        p_vals = tuple(jax.device_put(p._data._data, self._param_sharding(p))
-                       for p in self._params)
-        s_vals = tuple(jax.device_put(s._data, self.mesh.replicated())
-                       for s in self._state_nds)
+        d_sh, l_sh = self._data_shardings(len(data.shape), len(label.shape))
+        d = jax.device_put(data._data, d_sh)
+        l = jax.device_put(label._data, l_sh)
+        p_sh, s_sh = self._shardings()
+        p_vals = tuple(jax.device_put(p._data._data, sh)
+                       for p, sh in zip(self._params, p_sh))
+        s_vals = tuple(jax.device_put(s._data, sh)
+                       for s, sh in zip(self._state_nds, s_sh))
 
+        if _ttrace._ENABLED:
+            _M_STEP_DISPATCHES.inc()
         new_p, new_s, loss = fn(key, t, lr_vec, rescale, p_vals, s_vals, d, l)
         for p, v in zip(self._params, new_p):
             p._data._set_data(v)

@@ -1,0 +1,317 @@
+"""GSPMD-native sharding engine: declarative partition rules over named
+parameter trees (ROADMAP 2 — "the refactor that unlocks pod scale").
+
+The reference scales by hand-built per-model shard code (each parallel
+lane wires its own 2-axis mesh: dp×tp, dp×pp, dp×ep, dp×sp) and dense
+replication rides the kvstore.  The GSPMD approach (Xu et al. 2021)
+inverts that: models declare a *layout* — regex rules over their named
+parameter tree mapping params to logical mesh axes — and XLA's SPMD
+partitioner materializes the parallelism (sharded matmuls, the gradient
+psum-scatters, the resharding collectives) from nothing but input/output
+shardings on one jitted program.  This module is that layer:
+
+ - ``match_partition_rules(rules, params)`` — the fmengine pattern
+   (SNIPPETS [3]): first ``re.search`` match wins, scalar leaves are
+   never partitioned, unmatched params fall back to replication (bit
+   identity with the unsharded run) or raise under
+   ``on_unmatched='error'``.
+ - ``LOGICAL_AXES`` — the axis-name vocabulary rules may speak
+   (``dp``/``tp``/``sp``/…); a rule naming an axis outside it is a typo
+   and raises at rule-compile time, while a *matched* axis the current
+   mesh doesn't carry simply degrades to unsharded, so one rule set runs
+   unchanged from a laptop to a pod slice.
+ - rule packs for the zoo (``llama_rules``, ``bert_rules``,
+   ``transformer_rules``) sharing ``DEFAULT_TAIL`` (embedding /
+   layernorm / bias defaults) — these subsume the per-model
+   ``apply_tp_shardings`` bodies, which now delegate here.
+ - ``resolve_spec(spec, mesh, shape)`` — logical spec → concrete
+   ``NamedSharding`` with degradation (absent mesh axes, indivisible
+   dims) counted in ``mxnet_sharding_fallback_params_total``.
+
+Consumers: ``parallel.TrainStep(partition_rules=...)`` resolves per-param
+NamedShardings at trace time (params AND same-shaped optimizer state),
+``gluon.Trainer`` skips the kvstore allreduce for params the mesh already
+reduces (``Parameter.mesh_reduced``), and ``mx.checkpoint`` round-trips
+sharded params (gather-on-save by default, sharded-save under
+``MXNET_CHECKPOINT_SHARDED=1``).
+"""
+
+from __future__ import annotations
+
+import re
+
+from .base import MXNetError
+from . import telemetry as _tel
+from .telemetry import tracer as _ttrace
+
+__all__ = ["LOGICAL_AXES", "match_partition_rules", "apply_rules",
+           "resolve_spec", "rule_pack", "llama_rules", "bert_rules",
+           "transformer_rules", "DEFAULT_TAIL", "mark_mesh_reduced"]
+
+# The logical-axis vocabulary rules may name.  Convention (the scaling
+# playbook): outermost axis = data parallel (DCN-friendly), inner axes =
+# tensor/sequence parallel (ICI-local).
+LOGICAL_AXES = {
+    "dp": "data parallel — batch dim; grads psum over it",
+    "tp": "tensor (megatron) parallel — matmul in/out-feature dims",
+    "sp": "sequence/context parallel — the sequence dim of activations",
+    "pp": "pipeline parallel — layer/stage dim (pipeline.gpipe)",
+    "ep": "expert parallel — the expert dim of MoE stacks",
+    "mp": "generic model parallel — coarse table splits (examples)",
+    "fsdp": "fully-sharded data parallel — param shards gathered at use",
+}
+
+_M_RESOLVED = _tel.counter(
+    "mxnet_sharding_resolved_params_total",
+    "Params whose partition rule resolved to a sharded NamedSharding.")
+_M_FALLBACK = _tel.counter(
+    "mxnet_sharding_fallback_params_total",
+    "Params that fell back to replication (no rule matched, mesh lacked "
+    "the axis, or a dim was not divisible by its mesh axes).")
+_M_SKIPPED_ALLREDUCE = _tel.counter(
+    "mxnet_sharding_skipped_allreduce_total",
+    "Params gluon.Trainer skipped in the kvstore allreduce because the "
+    "mesh computation already reduced their gradients (mesh_reduced).")
+
+
+def _axes_of(entry):
+    """The axis names inside one PartitionSpec entry (str | tuple | None)."""
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+def _check_rules(rules):
+    """Compile patterns and validate specs against LOGICAL_AXES once."""
+    compiled = []
+    for i, (pattern, spec) in enumerate(rules):
+        try:
+            pat = re.compile(pattern)
+        except re.error as exc:
+            raise MXNetError(
+                f"partition rule {i} has an invalid regex "
+                f"{pattern!r}: {exc}") from exc
+        spec = tuple(spec)
+        for entry in spec:
+            for axis in _axes_of(entry):
+                if axis not in LOGICAL_AXES:
+                    raise MXNetError(
+                        f"partition rule {pattern!r} names unknown logical "
+                        f"axis {axis!r}; vocabulary: "
+                        f"{sorted(LOGICAL_AXES)}")
+        compiled.append((pat, spec))
+    return compiled
+
+
+def _named_leaves(params):
+    """name -> shape-bearing leaf, from a net, ParameterDict, or dict."""
+    if hasattr(params, "collect_params"):
+        params = params.collect_params()
+    if hasattr(params, "items"):
+        return dict(params.items())
+    raise MXNetError(
+        "match_partition_rules wants a Block, ParameterDict, or "
+        f"name->param dict; got {type(params).__name__}")
+
+
+def _shape_of(name, leaf):
+    if isinstance(leaf, (tuple, list)):
+        return tuple(leaf)
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        raise MXNetError(
+            f"param {name!r} has no resolved shape (deferred init?) — run "
+            "a forward pass before matching partition rules")
+    return tuple(shape)
+
+
+def match_partition_rules(rules, params, on_unmatched="replicate"):
+    """Map a named param tree to partition specs, first match wins.
+
+    ``rules`` is an ordered list of ``(regex, spec)`` where ``spec`` is a
+    per-dim tuple of logical axis names (or ``None``, or a tuple of axes
+    for a dim sharded over several).  ``params`` is a Block,
+    ParameterDict, or ``name -> leaf`` dict (leaves need ``.shape``; a
+    plain shape tuple also works).  Returns ``{name: spec}``.
+
+    Semantics (the fmengine recipe):
+     - scalar leaves (ndim 0 or one element) are never partitioned;
+     - the FIRST rule whose regex ``re.search``-matches the name wins;
+     - a matched spec longer than the leaf's rank is a layout bug and
+       raises;
+     - unmatched params replicate (``spec ()``, bit-identical to the
+       dense run) — or raise when ``on_unmatched='error'``.
+    """
+    if on_unmatched not in ("replicate", "error"):
+        raise MXNetError(
+            f"on_unmatched must be 'replicate' or 'error', "
+            f"got {on_unmatched!r}")
+    compiled = _check_rules(rules)
+    out = {}
+    unmatched = []
+    for name, leaf in _named_leaves(params).items():
+        shape = _shape_of(name, leaf)
+        size = 1
+        for s in shape:
+            size *= s
+        if len(shape) == 0 or size == 1:
+            out[name] = ()          # never partition scalars
+            continue
+        for pat, spec in compiled:
+            if pat.search(name) is not None:
+                if len(spec) > len(shape):
+                    raise MXNetError(
+                        f"partition rule {pat.pattern!r} has spec {spec} "
+                        f"of rank {len(spec)} but param {name!r} has "
+                        f"shape {shape}")
+                out[name] = spec
+                break
+        else:
+            unmatched.append(name)
+            out[name] = ()
+    if unmatched and on_unmatched == "error":
+        raise MXNetError(
+            f"no partition rule matched params {sorted(unmatched)} "
+            "(on_unmatched='error')")
+    return out
+
+
+def resolve_spec(spec, mesh, shape=None):
+    """Logical spec -> concrete ``NamedSharding`` on ``mesh``.
+
+    Degradation (counted in ``mxnet_sharding_fallback_params_total``):
+    axes the mesh doesn't carry drop to unsharded, and — when ``shape``
+    is given — a dim not divisible by the product of its mesh axis sizes
+    drops to unsharded, so the same rule set runs bit-identically on
+    meshes too small (or shapes too ragged) to shard.  Returns the
+    sharding and whether anything actually sharded.
+    """
+    resolved = []
+    for d, entry in enumerate(tuple(spec or ())):
+        axes = tuple(a for a in _axes_of(entry) if a in mesh.axis_names)
+        if axes and shape is not None:
+            n = 1
+            for a in axes:
+                n *= mesh.axis_size(a)
+            if shape[d] % n != 0:
+                axes = ()       # indivisible dim: degrade to unsharded
+        if not axes:
+            resolved.append(None)
+        elif len(axes) == 1:
+            resolved.append(axes[0])
+        else:
+            resolved.append(axes)
+    sharded = any(a is not None for a in resolved)
+    if _ttrace._ENABLED:
+        (_M_RESOLVED if sharded else _M_FALLBACK).inc()
+    if not sharded:
+        return mesh.replicated(), False
+    return mesh.sharded(*resolved), True
+
+
+def apply_rules(net_or_params, rules, on_unmatched="replicate",
+                mesh_reduced=None):
+    """Match ``rules`` and store each spec as ``Parameter.sharding``.
+
+    The hints are consumed by ``parallel.TrainStep`` (and anything else
+    reading ``Parameter.sharding``); empty specs clear the hint.  When
+    ``mesh_reduced`` is not None every parameter's ``mesh_reduced`` flag
+    is set to it (see :func:`mark_mesh_reduced`).  Returns the
+    ``{name: spec}`` mapping.
+    """
+    leaves = _named_leaves(net_or_params)
+    specs = match_partition_rules(rules, leaves, on_unmatched=on_unmatched)
+    for name, p in leaves.items():
+        p.sharding = specs[name] or None
+        if mesh_reduced is not None:
+            p.mesh_reduced = bool(mesh_reduced)
+    return specs
+
+
+def mark_mesh_reduced(net_or_params, value=True):
+    """Flag params whose gradients a mesh computation already reduces.
+
+    A train step jitted over a mesh (``parallel.TrainStep``) comes back
+    with globally-reduced gradients — GSPMD inserted the psum(-scatter)
+    over the data axis.  A local/device kvstore reduction over the same
+    devices would double-count, so ``gluon.Trainer`` skips flagged params
+    in its allreduce (non-dist stores only; cross-process reduction is
+    still the dist store's job).  Gate: ``MXNET_SHARDING_SKIP_ALLREDUCE``.
+    """
+    for _, p in _named_leaves(net_or_params).items():
+        p.mesh_reduced = bool(value)
+
+
+# --------------------------------------------------------------------------
+# rule packs for the zoo (megatron layouts over Gluon's flat param names)
+# --------------------------------------------------------------------------
+
+def DEFAULT_TAIL(tp="tp"):
+    """Embedding / layernorm / bias defaults shared by the packs.
+
+    Vocab-dim sharding for embedding tables (column-parallel output
+    embeddings), replication for norm scales and biases — append AFTER
+    model-specific rules so first-match-wins keeps the specific layout.
+    """
+    return [
+        (r"(tok|word|embed)[a-z0-9]*_weight$", (tp, None)),
+        (r"(gamma|beta)$", ()),
+        (r"norm_weight$", ()),
+        (r"_bias$", ()),
+    ]
+
+
+def llama_rules(tp="tp"):
+    """Megatron TP layout for the llama GQA decoder (model_zoo.llama).
+
+    Column-parallel (out-features): q/k/v, gate, up, lm_head; GQA k/v
+    shard their ``hd * kv_heads`` dim the same way.  Row-parallel
+    (in-features): o_proj, down.  ``tok_weight`` must precede the
+    ``k_weight$`` search (first-match-wins is the guard: 'tok_weight'
+    ends with 'k_weight' too), which DEFAULT_TAIL's embedding rule and
+    its position here make explicit.
+    """
+    return [
+        (r"tok_weight$", (tp, None)),
+        (r"(q|k|v|gate|up|lm_head)_weight$", (tp, None)),
+        (r"(o|down)_weight$", (None, tp)),
+    ] + DEFAULT_TAIL(tp)
+
+
+def bert_rules(tp="tp"):
+    """Megatron TP layout for the BERT encoder (model_zoo.bert):
+    qkv + ffn1 column-parallel, attn proj + ffn2 row-parallel,
+    word/decoder tables vocab-sharded, everything else replicated."""
+    return [
+        (r"(attn_qkv|ffn1)_weight$", (tp, None)),
+        (r"(attn_proj|ffn2)_weight$", (None, tp)),
+        (r"decoder_weight$", (tp, None)),
+        (r"position_weight$", ()),
+    ] + DEFAULT_TAIL(tp)
+
+
+def transformer_rules(tp="tp"):
+    """Megatron TP layout for the MT transformer (model_zoo.transformer):
+    fused self/cross qkv + ffn1 column-parallel, output projections +
+    ffn2 row-parallel, embeddings vocab-sharded via the tail."""
+    return [
+        (r"(attn_qkv|self_qkv|cross_q|cross_kv|ffn1)_weight$", (tp, None)),
+        (r"(attn_proj|self_proj|cross_proj|ffn2)_weight$", (None, tp)),
+    ] + DEFAULT_TAIL(tp)
+
+
+_RULE_PACKS = {
+    "llama": llama_rules,
+    "bert": bert_rules,
+    "transformer": transformer_rules,
+}
+
+
+def rule_pack(name, tp="tp"):
+    """A named zoo rule pack: ``rule_pack('llama')`` etc."""
+    if name not in _RULE_PACKS:
+        raise MXNetError(
+            f"unknown rule pack {name!r}; options {sorted(_RULE_PACKS)}")
+    return _RULE_PACKS[name](tp=tp)

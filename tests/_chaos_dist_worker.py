@@ -1,0 +1,107 @@
+"""Worker body for the n=4 distributed chaos suite (ISSUE 8 satellite /
+ROADMAP 4): the PR-3 resilience machinery — deadline-bounded collectives,
+atomic manifest checkpoints, auto_resume — exercised against a REAL
+4-process topology.  Not collected by pytest (no test_ prefix).
+
+Modes (argv[1]):
+ - ``clean``          — run all steps, checkpoint each, dump final params.
+ - ``die-allreduce``  — the highest rank arms a chaos ``exit`` fault on
+   the ``kvstore.allreduce`` site right before step 3's reduction:
+   worker death MID-ALLREDUCE.  Survivors must NOT hang — the PR-3
+   Deadline turns the dead peer into KVStoreTimeoutError and the run
+   exits nonzero with every rank's last COMMITTED step aligned (the
+   dying step never completes anywhere).
+ - ``die-checkpoint`` — every rank arms a chaos ``exit`` on the
+   ``checkpoint.save`` site at step 4: preemption MID-CHECKPOINT, inside
+   the atomicity-critical window (data written, manifest not yet
+   committed).  On restart the orphaned step must be invisible and the
+   job resumes from the previous committed step.
+
+Each rank trains the same seeded net on rank+step-deterministic data, so
+a ``clean`` run after any fault sequence must reproduce the uninterrupted
+reference run's final parameters BIT-identically.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        # multi-proc CPU collectives need gloo BEFORE backend init
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
+
+jax.distributed.initialize(
+    coordinator_address=os.environ["MXNET_DIST_COORDINATOR"],
+    num_processes=int(os.environ["MXNET_DIST_NUM_WORKERS"]),
+    process_id=int(os.environ["MXNET_DIST_RANK"]))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu.resilience import chaos  # noqa: E402
+
+TOTAL = 6
+
+
+def main():
+    mode, outdir = sys.argv[1], sys.argv[2]
+    rank = int(os.environ["MXNET_DIST_RANK"])
+    n = int(os.environ["MXNET_DIST_NUM_WORKERS"])
+
+    kv = mx.kv.create("dist_tpu_sync")
+    kv.set_bucket_size(0)   # per-key pushes: every one crosses the
+    #                         kvstore.allreduce chaos site
+    mx.random.seed(7)       # identical init on every rank
+    net = gluon.nn.Dense(4, in_units=6, prefix="net_")
+    net.initialize(mx.initializer.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.05}, kvstore=kv)
+    lossf = gluon.loss.L2Loss()
+
+    def batch(step):
+        r = np.random.RandomState(1000 * rank + step)
+        return (mx.nd.array(r.randn(8, 6).astype(np.float32)),
+                mx.nd.array(r.randn(8, 4).astype(np.float32)))
+
+    def train_fn(step):
+        if mode == "die-allreduce" and rank == n - 1 and step == 3:
+            # the NEXT allreduce hit is step 3's gradient reduction:
+            # death strictly mid-allreduce, no hit counting needed
+            chaos.inject("kvstore.allreduce", kind="exit", times=1)
+        if mode == "die-checkpoint" and step == 4:
+            # fires inside CheckpointManager.save between data write and
+            # manifest commit — the window atomicity must cover
+            chaos.inject("checkpoint.save", kind="exit", times=1)
+        x, y = batch(step)
+        with autograd.record():
+            loss = lossf(net(x), y)
+        loss.backward()
+        tr.step(x.shape[0])
+        return step < TOTAL - 1
+
+    # ONE shared checkpoint tree for the whole job (the orbax multihost
+    # contract: the primary process writes, every process barriers) — a
+    # per-rank directory would desync the manager's cross-process
+    # coordination
+    last = mx.checkpoint.auto_resume(
+        train_fn, os.path.join(outdir, "ckpt"),
+        net=net, trainer=tr, save_every=1, max_restarts=0)
+    assert last == TOTAL - 1, last
+
+    np.savez(os.path.join(outdir, f"final_rank{rank}.npz"),
+             **{k: p.data().asnumpy()
+                for k, p in net.collect_params().items()})
+    print(f"worker {rank}/{n} [{mode}]: OK (last step {last})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

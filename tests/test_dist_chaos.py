@@ -1,0 +1,110 @@
+"""n=4 distributed chaos suite (ISSUE 8 satellite / ROADMAP 4): the PR-3
+resilience machinery against a REAL 4-process topology — worker death
+mid-allreduce, preemption mid-checkpoint — asserting bit-identical
+elastic resume.
+
+Flow (one shared checkpoint tree, four launches of
+tests/_chaos_dist_worker.py):
+
+ 1. ``die-allreduce``: rank 3 chaos-exits inside step 3's gradient
+    reduction.  Survivors must exit promptly via the deadline (no hang)
+    and nobody commits step 3 — every rank's manifest stays aligned at
+    step 2, which is what makes the elastic restart consistent.
+ 2. ``die-checkpoint``: the restarted job replays step 3 and every rank
+    chaos-exits INSIDE step 4's checkpoint save (data written, manifest
+    not committed).  The orphaned step-4 directory must stay invisible.
+ 3. ``clean``: the final restart resumes from the committed step 3,
+    replays 4 and 5, and dumps final params.
+ 4. A separate uninterrupted ``clean`` reference run.
+
+Acceptance: the thrice-killed job's final parameters are BIT-identical
+to the uninterrupted run's, on every rank.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # four 4-process jax launches (~2 min)
+
+
+def _launch(mode, outdir, n=4, timeout=240):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        from launch import launch_local
+    finally:
+        sys.path.pop(0)
+    worker = os.path.join(repo, "tests", "_chaos_dist_worker.py")
+    env = {
+        "MXNET_TPU_JIT_IMPERATIVE": "1",
+        # a dead peer must surface as KVStoreTimeoutError well before the
+        # launcher kill — this bound IS the no-hang assertion
+        "MXNET_KVSTORE_TIMEOUT_S": "20",
+        "MXNET_RESILIENCE_BACKOFF_S": "0.001",
+    }
+    t0 = time.monotonic()
+    codes = launch_local(n, [sys.executable, worker, mode, outdir],
+                         env_extra=env, cpu_devices_per_worker=1,
+                         timeout=timeout)
+    return codes, time.monotonic() - t0
+
+
+def _committed_steps(outdir):
+    path = os.path.join(outdir, "ckpt", "manifest.json")
+    with open(path) as f:
+        return sorted(json.load(f)["committed"])
+
+
+def _finals(outdir, n=4):
+    out = {}
+    for r in range(n):
+        with np.load(os.path.join(outdir, f"final_rank{r}.npz")) as z:
+            out[r] = {k: z[k].copy() for k in z.files}
+    return out
+
+
+def test_n4_chaos_death_and_preemption_resume_bit_identical(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    n = 4
+    chaotic = str(tmp_path / "chaotic")
+    ref = str(tmp_path / "ref")
+    os.makedirs(chaotic)
+    os.makedirs(ref)
+
+    # 1. worker death mid-allreduce: every rank must exit nonzero and
+    #    PROMPTLY (deadline, not launcher kill), with no rank committing
+    #    the dying step
+    codes, elapsed = _launch("die-allreduce", chaotic)
+    assert all(c != 0 for c in codes), codes
+    assert elapsed < 180, f"survivors hung {elapsed:.0f}s (deadline broken)"
+    assert _committed_steps(chaotic) == [0, 1, 2]  # step 3 never committed
+
+    # 2. elastic restart replays step 3, then preemption mid-checkpoint
+    #    at step 4: data written, manifest commit never reached
+    codes, _ = _launch("die-checkpoint", chaotic)
+    assert all(c != 0 for c in codes), codes
+    assert _committed_steps(chaotic)[-1] == 3  # step 4's save is invisible
+
+    # 3. final elastic restart: resumes at 4, finishes, dumps params
+    codes, _ = _launch("clean", chaotic)
+    assert codes == [0] * n, codes
+
+    # 4. uninterrupted reference
+    codes, _ = _launch("clean", ref)
+    assert codes == [0] * n, codes
+
+    got, want = _finals(chaotic), _finals(ref)
+    for r in range(n):
+        assert set(got[r]) == set(want[r])
+        for k in want[r]:
+            np.testing.assert_array_equal(
+                got[r][k], want[r][k],
+                err_msg=f"rank {r} param {k} diverged after chaos resume")
+        # replicas agree across ranks too (the reduction kept them synced)
+        for k in want[0]:
+            np.testing.assert_array_equal(got[r][k], got[0][k])

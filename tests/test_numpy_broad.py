@@ -221,6 +221,120 @@ def test_np_autograd_through_np_functions():
     onp.testing.assert_allclose(g, want, rtol=1e-4, atol=1e-5)
 
 
+# ---------------------------------------------------------------------------
+# delegated-surface parity extension (ISSUE 8 satellite, VERDICT weak #6):
+# a representative ~30-function slice across the three behavioral axes the
+# thin delegation could silently get wrong — dtype promotion, axis kwargs
+# (tuple / negative / keepdims), and python-scalar / 0-d operands.
+# ---------------------------------------------------------------------------
+
+# dtype pairs where numpy and the XLA lattice agree (int32+float32 is
+# deliberately absent: numpy value-promotes it to float64, which the
+# x64-disabled backend cannot represent — a documented divergence)
+_PROMO_PAIRS = [("int16", "float32"), ("int8", "float32"),
+                ("int8", "int32"), ("uint8", "int32"),
+                ("bool", "int32"), ("int32", "int32"),
+                ("float32", "float32")]
+_PROMO_FNS = ["add", "subtract", "multiply", "maximum", "minimum"]
+
+
+@pytest.mark.parametrize("da,db", _PROMO_PAIRS,
+                         ids=[f"{a}+{b}" for a, b in _PROMO_PAIRS])
+@pytest.mark.parametrize("name", _PROMO_FNS)
+def test_np_dtype_promotion(name, da, db):
+    av = onp.array([1, 0, 3]).astype(da)
+    bv = onp.array([2, 5, 1]).astype(db)
+    got = getattr(np, name)(np.array(av), np.array(bv)).asnumpy()
+    want = getattr(onp, name)(av, bv)
+    assert onp.dtype(got.dtype) == want.dtype, \
+        f"{name}({da},{db}): promoted to {got.dtype}, numpy {want.dtype}"
+    onp.testing.assert_array_equal(got, want)
+
+
+def test_np_division_promotes_ints_to_float():
+    """true_divide of ints must yield a float (numpy: float64; here the
+    x64-disabled analog float32) with numpy's values."""
+    a = np.array(onp.array([7, 8, 9], onp.int32))
+    b = np.array(onp.array([2, 4, 3], onp.int32))
+    got = np.divide(a, b).asnumpy()
+    assert onp.dtype(got.dtype).kind == "f"
+    onp.testing.assert_allclose(
+        got, onp.divide(onp.array([7, 8, 9]), onp.array([2, 4, 3])),
+        rtol=1e-6)
+
+
+_AXIS_FNS = ["sum", "mean", "prod", "std", "var", "max", "min"]
+
+
+@pytest.mark.parametrize("axis", [(0, 2), (1,), -1, -2, None],
+                         ids=["tuple02", "tuple1", "neg1", "neg2", "none"])
+@pytest.mark.parametrize("keepdims", [False, True])
+@pytest.mark.parametrize("name", _AXIS_FNS)
+def test_np_reduction_axis_kwargs(name, axis, keepdims):
+    x = onp.abs(_r((2, 3, 4), 21)) + 0.5
+    got = getattr(np, name)(np.array(x), axis=axis,
+                            keepdims=keepdims).asnumpy()
+    want = getattr(onp, name)(x, axis=axis, keepdims=keepdims)
+    assert got.shape == want.shape, \
+        f"{name} axis={axis} keepdims={keepdims}: {got.shape} vs {want.shape}"
+    onp.testing.assert_allclose(onp.asarray(got, want.dtype), want,
+                                rtol=2e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["argmax", "argmin", "cumsum"])
+@pytest.mark.parametrize("axis", [-1, 0])
+def test_np_index_and_scan_negative_axis(name, axis):
+    x = _r((3, 4), 22)
+    got = getattr(np, name)(np.array(x), axis=axis).asnumpy()
+    want = getattr(onp, name)(x, axis=axis)
+    if name == "cumsum":  # XLA's log-depth scan reassociates the sum
+        onp.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-6)
+    else:
+        onp.testing.assert_array_equal(got, want)
+
+
+_SCALAR_CASES = [
+    ("add", lambda m, a: m.add(a, 2)),
+    ("subtract", lambda m, a: m.subtract(a, 1.5)),
+    ("multiply", lambda m, a: m.multiply(a, 3)),
+    ("divide", lambda m, a: m.divide(a, 2.0)),
+    ("power", lambda m, a: m.power(a, 2)),
+    ("maximum", lambda m, a: m.maximum(a, 1.5)),
+    ("minimum", lambda m, a: m.minimum(a, 1.5)),
+    ("mod", lambda m, a: m.mod(a, 3)),
+    ("floor_divide", lambda m, a: m.floor_divide(a, 3)),
+    ("arctan2", lambda m, a: m.arctan2(a, 2.0)),
+]
+
+
+@pytest.mark.parametrize("case", _SCALAR_CASES,
+                         ids=[c[0] for c in _SCALAR_CASES])
+def test_np_python_scalar_operand(case):
+    name, fn = case
+    x = onp.abs(_r((3, 4), 23)) + 1.0
+    got = fn(np, np.array(x)).asnumpy()
+    want = fn(onp, x)
+    onp.testing.assert_allclose(onp.asarray(got, want.dtype), want,
+                                rtol=2e-5, atol=2e-6)
+
+
+def test_np_zero_d_arrays():
+    """0-d arrays flow through unary/binary/reduction like numpy's."""
+    z = np.array(3.5)
+    assert z.shape == ()
+    assert float(np.add(z, 1.5).asnumpy()) == 5.0
+    assert float(np.exp(np.array(0.0)).asnumpy()) == 1.0
+    # reducing a 0-d array is the identity, as in numpy
+    assert float(np.sum(z).asnumpy()) == 3.5
+    assert np.sum(z).shape == ()
+    # reducing a 1-d array to 0-d round-trips through python float
+    s = np.sum(np.array(onp.ones(4, onp.float32)))
+    assert s.shape == () and float(s.asnumpy()) == 4.0
+    # 0-d broadcasts against arrays like a scalar
+    got = np.multiply(np.array(onp.array([1.0, 2.0], onp.float32)), z)
+    onp.testing.assert_allclose(got.asnumpy(), [3.5, 7.0])
+
+
 def test_npx_set_np_toggles():
     mx.npx.set_np()
     try:
