@@ -55,6 +55,44 @@ import traceback
 import numpy as np
 
 
+def _lane_telemetry():
+    """Per-lane telemetry snapshot (ISSUE 10 satellite): key counters +
+    step-phase medians ride in every BENCH row, so trajectory files carry
+    bottleneck attribution (was the lane dispatch-bound? input-bound? did
+    it retrace?) and not just wall time.  Each TrainStep.run dispatch is
+    one StepClock "step" — phase medians are per-dispatch."""
+    try:
+        from mxnet_tpu import telemetry
+        counters = {}
+        for k in ("mxnet_sharding_step_dispatches_total",
+                  "mxnet_sharding_retraces_total",
+                  "mxnet_op_dispatch_total",
+                  "mxnet_trainer_steps_total"):
+            m = telemetry.REGISTRY.get(k)
+            if m is not None and m.value:
+                counters[k] = m.value
+        s = telemetry.STEP_CLOCK.summary()
+        phases = {p: round(v["median"] * 1e3, 3)
+                  for p, v in s.get("phases", {}).items()}
+        return {"counters": counters, "step_phase_median_ms": phases,
+                "verdict": s.get("verdict", "idle")}
+    except Exception as e:  # noqa: BLE001 — attribution must not kill a lane
+        return {"error": f"{type(e).__name__}: {e}"[:120]}
+
+
+def _telemetry_on():
+    """Enable telemetry for the measured lane, starting from a clean
+    slate — the retry ladder re-enters run_*_once in the SAME process, so
+    without the reset a half-batch row would embed counters and step
+    phases from the failed full-batch attempt.  (Host-side spans/counters
+    only; with scan_steps fused per dispatch the per-dispatch overhead is
+    noise next to the XLA program.)"""
+    from mxnet_tpu import telemetry
+    telemetry.enable()
+    telemetry.clear()            # spans + ledger + step-clock window
+    telemetry.REGISTRY.reset()   # counters attribute THIS attempt only
+
+
 def _peak_flops(dtype):
     """Per-chip peak for MFU accounting."""
     import jax
@@ -102,6 +140,7 @@ def run_vision_once(name, batch, dtype, scan_steps, dispatches):
     opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
                            multi_precision=(dtype == "bfloat16"))
     step = parallel.TrainStep(model, loss_fn, opt, mesh=mesh)
+    _telemetry_on()
 
     # one on-device batch scanned scan_steps times per dispatch: synthetic
     # data must not meter host->device bandwidth (a 224x224 batch is ~10MB;
@@ -126,7 +165,8 @@ def run_vision_once(name, batch, dtype, scan_steps, dispatches):
     vs = round(images_per_sec / 400.0, 4) if name.startswith("resnet50") \
         else 0.0
     extra = {"dtype": dtype, "batch": batch, "size": size,
-             "step_ms": round(1000 * dt / n_steps, 2), "loss": last_loss}
+             "step_ms": round(1000 * dt / n_steps, 2), "loss": last_loss,
+             "telemetry": _lane_telemetry()}
     if not name.startswith("resnet50"):
         extra["baseline_note"] = "no reference baseline for this model"
     return {
@@ -167,6 +207,7 @@ def run_once(name, batch, seq_len, dtype, scan_steps, dispatches):
     opt = mx.optimizer.Adam(learning_rate=1e-4,
                             multi_precision=(dtype == "bfloat16"))
     step = parallel.TrainStep(model, loss_fn, opt, mesh=mesh)
+    _telemetry_on()
 
     # per-step batches (stacked, scanned over) so every step sees fresh data
     def mk_batches(seed):
@@ -211,7 +252,7 @@ def run_once(name, batch, seq_len, dtype, scan_steps, dispatches):
         "extra": {"mfu": round(mfu, 4), "dtype": dtype, "batch": batch,
                   "seq_len": seq_len, "scan_steps": scan_steps,
                   "step_ms": round(1000 * dt / n_steps, 2),
-                  "loss": last_loss},
+                  "loss": last_loss, "telemetry": _lane_telemetry()},
     }
 
 
@@ -263,6 +304,7 @@ def run_llama_once(batch, seq_len, dtype, scan_steps, dispatches):
     opt = mx.optimizer.Adam(learning_rate=1e-4,
                             multi_precision=(dtype == "bfloat16"))
     step = parallel.TrainStep(model, loss_fn, opt, mesh=mesh)
+    _telemetry_on()
 
     def mk_batches(seed):
         r = np.random.RandomState(seed)
@@ -303,7 +345,7 @@ def run_llama_once(batch, seq_len, dtype, scan_steps, dispatches):
         "extra": {"mfu": round(mfu, 4), "dtype": dtype, "batch": batch,
                   "seq_len": seq_len, "scan_steps": scan_steps,
                   "step_ms": round(1000 * dt / n_steps, 2),
-                  "loss": last_loss},
+                  "loss": last_loss, "telemetry": _lane_telemetry()},
     }
 
 

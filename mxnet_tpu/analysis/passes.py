@@ -49,8 +49,8 @@ HOT_PATHS = {
                          "push", "pull", "pushpull", "pushpull_list",
                          "_fused_pushpull", "pushpull_flat",
                          "_split_fusable", "_stage_bucket"},
-    "gluon/trainer.py": {"step", "_allreduce_grads", "_update",
-                         "_update_impl", "_update_aggregated",
+    "gluon/trainer.py": {"step", "_allreduce_grads", "_allreduce_grads_impl",
+                         "_update", "_update_impl", "_update_aggregated",
                          "_update_fused", "_fused_kind"},
     "optimizer_fusion.py": None,
     # serving hot path: the per-iteration scheduler core and everything
@@ -72,6 +72,11 @@ HOT_PATHS = {
     "parallel.py": {"__call__", "run", "_param_sharding",
                     "_shardings", "_data_shardings", "_build",
                     "_build_multi"},
+    # observability plane (ISSUE 10): the StepClock feeds from the
+    # trainer/TrainStep step path and counter shipping rides the decode
+    # ack channel — both must stay host-sync-free and flag-disciplined
+    "telemetry/stepclock.py": {"begin_step", "note", "end_step"},
+    "telemetry/aggregate.py": {"counter_deltas", "absorb_counter_deltas"},
 }
 
 # GC05 additionally audits these (they sit on the per-batch/per-call path

@@ -58,6 +58,36 @@ KNOWN_VARS = {
     "MXNET_TELEMETRY_BUFFER": (
         "65536", int,
         "Span ring-buffer capacity (events); oldest events drop beyond it."),
+    # observability plane (ISSUE 10: aggregation + StepClock + flight rec)
+    "MXNET_TELEMETRY_DIR": (
+        None, str,
+        "Cross-process telemetry collection directory: every process "
+        "exports a rank-tagged span+metric snapshot here at exit (and on "
+        "flight-recorder dumps); rank 0 / tools/telemetry_report.py merge "
+        "the shards into ONE Chrome trace and ONE Prometheus snapshot. "
+        "Unset = no export."),
+    "MXNET_STEPCLOCK_WINDOW": (
+        "64", int,
+        "Steps the StepClock keeps for the rolling input-/comms-/compute-"
+        "bound verdict and telemetry.report()'s phase medians."),
+    "MXNET_FLIGHTREC": (
+        "1", int,
+        "If 1 (default), the crash flight recorder arms at import: "
+        "unhandled exceptions, deadline-exceeded, chaos 'exit' faults, "
+        "SIGTERM, and SIGUSR2 (on demand) each dump a bounded postmortem "
+        "(last spans, metric state, chaos sites, resolved knobs) per "
+        "rank.  0 disables the dumps and installs no handlers."),
+    "MXNET_FLIGHTREC_DIR": (
+        None, str,
+        "Directory for flight-recorder dumps (default: MXNET_TELEMETRY_DIR "
+        "when set, else ./flightrec)."),
+    "MXNET_FLIGHTREC_SPANS": (
+        "256", int,
+        "Most-recent trace events included in each flight-recorder dump."),
+    "MXNET_FLIGHTREC_MAX_DUMPS": (
+        "16", int,
+        "Flight-recorder dump-file cap per process (rate limit: a retry "
+        "loop hitting deadlines must not flood the disk)."),
     # data pipeline
     "MXNET_CPU_WORKER_NTHREADS": ("1", int, "Worker threads for host-side data aug."),
     # multi-core decode pipeline (ISSUE 7: io/pipeline.py)

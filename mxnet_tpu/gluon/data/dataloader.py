@@ -20,6 +20,7 @@ from ... import config
 from ... import ndarray as nd
 from ... import resilience as _res
 from ... import telemetry as _tel
+from ...telemetry import stepclock as _sclock
 from ...ndarray.ndarray import NDArray
 from ...resilience import chaos as _chaos
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
@@ -134,6 +135,9 @@ class DataLoader:
         if sp is not _tel.NULL_SPAN:
             _M_BATCHES.inc()
             _M_BATCH_SECONDS.observe(sp.duration_s)
+            # input-wait for the StepClock: this fetch blocks the step
+            # that consumes the batch (folded in at its begin_step)
+            _sclock.STEP_CLOCK.note("data_wait", sp.duration_s)
         return batch
 
     def __iter__(self):
@@ -215,6 +219,7 @@ class DataLoader:
                 if sp is not _tel.NULL_SPAN:
                     _M_BATCHES.inc()
                     _M_BATCH_SECONDS.observe(sp.duration_s)
+                    _sclock.STEP_CLOCK.note("data_wait", sp.duration_s)
                 yield out
         finally:
             self._io_pipeline_busy = False
@@ -267,6 +272,7 @@ class DataLoader:
             if sp is not _tel.NULL_SPAN:
                 _M_BATCHES.inc()
                 _M_BATCH_SECONDS.observe(sp.duration_s)
+                _sclock.STEP_CLOCK.note("data_wait", sp.duration_s)
             yield out
             if failures and failures >= self._max_pool_failures \
                     and self._pool is not None:

@@ -31,6 +31,7 @@ from __future__ import annotations
 from ..base import MXNetError
 from .. import optimizer as opt
 from .. import telemetry as _tel
+from ..telemetry import stepclock as _sclock
 from ..telemetry import tracer as _ttrace
 from ..resilience import chaos as _chaos
 from .parameter import ParameterDict, Parameter
@@ -159,6 +160,11 @@ class Trainer:
         self._init_kvstore()
         if _chaos._ACTIVE:
             _chaos.hit("trainer.step")  # named chaos site (mid-run faults)
+        if _ttrace._ENABLED:
+            # StepClock (ISSUE 10): open the step — the gap since the last
+            # step (forward/backward/user code) and any pending data-wait
+            # notes from the DataLoader fold into this step's attribution
+            _sclock.STEP_CLOCK.begin_step()
         with _tel.span("trainer.step", "trainer", batch_size=batch_size) as sp:
             scaler = getattr(self, "_amp_loss_scaler", None)
             base_scale = getattr(self, "_amp_original_scale", self._scale)
@@ -188,6 +194,7 @@ class Trainer:
         if sp is not _tel.NULL_SPAN:
             _M_STEPS.inc()
             _M_STEP_SECONDS.observe(sp.duration_s)
+            _sclock.STEP_CLOCK.end_step()
 
     def allreduce_grads(self):
         self._init_kvstore()
@@ -204,8 +211,18 @@ class Trainer:
         self._flat_handoff = None
         if self._kvstore is None:
             return
-        with _tel.span("trainer.allreduce", "trainer",
-                       update_on_kvstore=self._update_on_kvstore):
+        sp = _tel.span("trainer.allreduce", "trainer",
+                       update_on_kvstore=self._update_on_kvstore)
+        try:
+            self._allreduce_grads_impl(sp, allow_flat)
+        finally:
+            if sp is not _tel.NULL_SPAN:
+                # comms phase for the StepClock verdict (every internal
+                # return path lands here with the span already closed)
+                _sclock.STEP_CLOCK.note("comms", sp.duration_s)
+
+    def _allreduce_grads_impl(self, sp, allow_flat):
+        with sp:
             if self._update_on_kvstore:
                 # per-key: the store runs the optimizer inside push and pull
                 # broadcasts the updated WEIGHTS (no fused analog — the
@@ -293,8 +310,10 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):  # noqa: ARG002
-        with _tel.span("trainer.optimizer", "trainer"):
+        with _tel.span("trainer.optimizer", "trainer") as sp:
             self._update_impl()
+        if sp is not _tel.NULL_SPAN:
+            _sclock.STEP_CLOCK.note("optimizer", sp.duration_s)
 
     def _fused_kind(self):
         """'adam'/'sgd' when the flat-buffer fused optimizer path applies

@@ -152,8 +152,12 @@ def _read_payload(fd, off, length):
 
 def _decode_chunk(slab_name, start_slot, recs):
     """Decode ``recs = [(offset, length, seed), ...]`` into the slab at
-    ``start_slot..`` — the pool task body.  Returns a tiny ack; the image
-    bytes never cross the process boundary."""
+    ``start_slot..`` — the pool task body.  Returns a tiny ack (count,
+    seconds, counter deltas); the image bytes never cross the process
+    boundary.  The deltas leg is the worker's telemetry export channel
+    (ISSUE 10): whatever counters moved in this worker since its last ack
+    (chaos faults, resilience events) ride back to the parent's registry
+    instead of dying with the pool."""
     from ..resilience import chaos
     if chaos._ACTIVE:
         chaos.hit("io.decode")
@@ -168,7 +172,8 @@ def _decode_chunk(slab_name, start_slot, recs):
         slot = start_slot + i
         _, label = _decode_record(raw, cfg, rng, out=imgs[slot])
         labels[slot] = label
-    return len(recs), time.perf_counter() - t0
+    return (len(recs), time.perf_counter() - t0,
+            _tel.aggregate.counter_deltas())
 
 
 # --------------------------------------------------------------------------
@@ -426,7 +431,12 @@ class PooledDecodePipeline:
             stale = fgen != self._gen   # that chunk's pool died after issue
             if fut is not None and not stale:
                 try:
-                    n, dt = fut.result(self._timeout)
+                    n, dt, deltas = fut.result(self._timeout)
+                    if deltas:
+                        # worker counters ride the ack channel home
+                        # (unconditional — chaos/resilience counters
+                        # count regardless of the span flag)
+                        _tel.aggregate.absorb_counter_deltas(deltas)
                     if tel_on:
                         _M_DECODED.inc(n)
                         _M_DECODE_SECONDS.observe(dt)

@@ -180,6 +180,7 @@ class KVStoreDistTPUSync(KVStoreLocal):
                     pass
                 elif "timed out" in msg or "timeout" in msg \
                         or "deadline" in msg:
+                    _tel.flightrec.dump("deadline.dist.bringup", exc=e)
                     raise KVStoreTimeoutError(
                         f"distributed bring-up: rank {rank} could not "
                         f"rendezvous with all {nproc} workers at {coord} "
@@ -195,6 +196,13 @@ class KVStoreDistTPUSync(KVStoreLocal):
                     "kvstore — or call jax.distributed.initialize — before "
                     "any array/computation touches the backend.")
         self._initialized = True
+        # rank-tag this process's telemetry (ISSUE 10): snapshots exported
+        # into MXNET_TELEMETRY_DIR and flight-recorder dumps carry the
+        # rank, and rank 0 merges them into one job-wide view
+        try:
+            _tel.aggregate.set_rank(jax.process_index())
+        except Exception:  # noqa: BLE001 — telemetry must not break bring-up
+            pass
 
     @property
     def rank(self):

@@ -27,6 +27,7 @@ performance path.
 from __future__ import annotations
 
 import functools
+import time as _time
 
 import numpy as _np
 
@@ -34,6 +35,7 @@ from .base import MXNetError
 from .context import Context
 from . import ndarray as nd
 from . import telemetry as _tel
+from .telemetry import stepclock as _sclock
 from .telemetry import tracer as _ttrace
 from .ndarray.ndarray import NDArray
 
@@ -687,6 +689,13 @@ class TrainStep:
         rescale = _np.float32(self.optimizer.rescale_grad)
         keys = jax.random.split(_rnd.get_key(), steps)
 
+        # one flag read per dispatch (graftcheck GC05); the StepClock
+        # treats each run() dispatch as one "step" — h2d is the measured
+        # device_put block, everything else lands in compute
+        enabled = _ttrace._ENABLED
+        if enabled:
+            _sclock.STEP_CLOCK.begin_step()
+            _t0 = _time.perf_counter()
         lead = 1 if stacked else 0
         d_sh, l_sh = self._data_shardings(len(data.shape) - lead,
                                           len(label.shape) - lead,
@@ -699,7 +708,8 @@ class TrainStep:
         s_vals = tuple(jax.device_put(s._data, sh)
                        for s, sh in zip(self._state_nds, s_sh))
 
-        if _ttrace._ENABLED:
+        if enabled:
+            _sclock.STEP_CLOCK.note("h2d", _time.perf_counter() - _t0)
             _M_STEP_DISPATCHES.inc()
         new_p, new_s, losses = fn(keys, ts, lr_vecs, rescale, p_vals, s_vals,
                                   d, l)
@@ -707,6 +717,8 @@ class TrainStep:
             p._data._set_data(v)
         for s, v in zip(self._state_nds, new_s):
             s._set_data(v)
+        if enabled:
+            _sclock.STEP_CLOCK.end_step()
         return NDArray._from_data(losses)
 
     # -- call -----------------------------------------------------------------
@@ -742,6 +754,14 @@ class TrainStep:
         from . import random as _rnd
         key = _rnd.get_key()
 
+        # one flag read per dispatch (graftcheck GC05); StepClock: the
+        # device_put block is h2d, the remainder of the step is compute
+        # (the fused trace folds comms+optimizer into one XLA program —
+        # phases inside the jit are not host-splittable)
+        enabled = _ttrace._ENABLED
+        if enabled:
+            _sclock.STEP_CLOCK.begin_step()
+            _t0 = _time.perf_counter()
         d_sh, l_sh = self._data_shardings(len(data.shape), len(label.shape))
         d = jax.device_put(data._data, d_sh)
         l = jax.device_put(label._data, l_sh)
@@ -751,11 +771,14 @@ class TrainStep:
         s_vals = tuple(jax.device_put(s._data, sh)
                        for s, sh in zip(self._state_nds, s_sh))
 
-        if _ttrace._ENABLED:
+        if enabled:
+            _sclock.STEP_CLOCK.note("h2d", _time.perf_counter() - _t0)
             _M_STEP_DISPATCHES.inc()
         new_p, new_s, loss = fn(key, t, lr_vec, rescale, p_vals, s_vals, d, l)
         for p, v in zip(self._params, new_p):
             p._data._set_data(v)
         for s, v in zip(self._state_nds, new_s):
             s._set_data(v)
+        if enabled:
+            _sclock.STEP_CLOCK.end_step()
         return NDArray._from_data(loss)

@@ -170,6 +170,9 @@ def hit(site, **ctx):
             raise ChaosWorkerDeath(f.message)
         elif f.kind == "exit":
             import os
+            # os._exit skips every hook (atexit, excepthook) — the flight
+            # recorder's postmortem must be written BEFORE the plug pulls
+            _tel.flightrec.dump(f"chaos.exit.{site}")
             os._exit(1)
 
 

@@ -207,6 +207,10 @@ class Deadline:
         if not done.wait(t):
             self._abandon()
             _M_DEADLINE.inc()
+            # flight recorder (ISSUE 10): a blown deadline is exactly the
+            # "dead peer" moment the black box exists for — every survivor
+            # of a chaos-lane worker death leaves a postmortem here
+            _tel.flightrec.dump(f"deadline.{self.site or 'call'}")
             raise KVStoreTimeoutError(
                 f"{self.site or 'call'} exceeded its {t:g}s deadline "
                 "(MXNET_KVSTORE_TIMEOUT_S); a peer is likely dead or "

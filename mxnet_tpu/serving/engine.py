@@ -41,6 +41,7 @@ import time
 
 from .. import config
 from .. import telemetry as _tel
+from ..telemetry import tracer as _ttrace
 from ..base import MXNetError
 from ..resilience import Deadline, ResilienceError
 from .cache import CacheOOMError, PagedKVCache
@@ -286,6 +287,17 @@ class ServingEngine:
                 req.finish_t = time.perf_counter()
                 req.done.set()
                 return ResultHandle(req)
+            # request span tree (ISSUE 10): one async 'b'..'e' pair keyed
+            # by rid threads queue -> prefill -> decode steps -> finish
+            # through the trace; _admit_one/_emit/_finish add the interior
+            # markers and the prefill/decode spans carry rid args.  The
+            # 'b' is emitted BEFORE the queue append (still under the
+            # lock): once appended, a background scheduler thread could
+            # admit and emit interior events ahead of the begin
+            _ttrace.async_event(
+                "request", "serving.request", "b", req.rid,
+                prompt_tokens=len(req.prompt),
+                max_new_tokens=req.max_new_tokens)
             self._queue.append(req)
             _G_QUEUE.set(len(self._queue))
         return ResultHandle(req)
@@ -304,6 +316,10 @@ class ServingEngine:
             _M_COMPLETED.inc()
             _H_E2E.observe(now - req.submit_t)
         req.done.set()
+        _ttrace.async_event(
+            "request", "serving.request", "e", req.rid,
+            tokens=len(req.outputs),
+            error=type(error).__name__ if error else None)
 
     def _evict(self, req, where):
         req.error = RequestDeadlineExceeded(
@@ -312,6 +328,8 @@ class ServingEngine:
         req.finish_t = time.perf_counter()
         _M_EVICTED.inc()
         req.done.set()
+        _ttrace.async_event("request", "serving.request", "e", req.rid,
+                            tokens=len(req.outputs), error="sla_" + where)
 
     def _preempt(self, slot_idx):
         """Free a running sequence's blocks and requeue it (front) for
@@ -327,6 +345,8 @@ class ServingEngine:
         slot.req.last_emit_t = None
         self._queue.appendleft(slot.req)
         _M_PREEMPTED.inc()
+        _ttrace.async_event("preempted", "serving.request", "n",
+                            slot.req.rid)
 
     def _recompute_prompt(self, req):
         return req.prompt + req.outputs
@@ -345,6 +365,8 @@ class ServingEngine:
         if req.first_token_t is None:
             req.first_token_t = now
             _H_TTFT.observe(now - req.submit_t)
+            _ttrace.async_event("first_token", "serving.request", "n",
+                                req.rid)
         elif req.last_emit_t is not None:
             _H_TPOT.observe(now - req.last_emit_t)
         req.last_emit_t = now
@@ -363,6 +385,8 @@ class ServingEngine:
             prompt = req.prompt
         self.cache.admit(slot_idx, self._admissible(req))
         _H_QWAIT.observe(now - req.queued_t)
+        _ttrace.async_event("admitted", "serving.request", "n", req.rid,
+                            slot=slot_idx)
         try:
             with _tel.span("serving.prefill", "serving", rid=req.rid):
                 first = self.adapter.prefill(slot_idx, prompt,
@@ -478,8 +502,12 @@ class ServingEngine:
                 # step's tokens credited to the wrong request (lock-free
                 # needs per-slot generation tags; submit() waiting out one
                 # decode step is the accepted cost)
-                with _tel.span("serving.decode_step", "serving",
-                               batch=len(active)):
+                sp = _tel.span("serving.decode_step", "serving",
+                               batch=len(active))
+                if sp is not _tel.NULL_SPAN:
+                    # rid linkage: which requests this iteration decoded
+                    sp.set(rids=[self._slots[i].req.rid for i in active])
+                with sp:
                     nxt = self.adapter.decode(tokens, self._tables_dev,
                                               self.cache.ctx_len)
                 _M_STEPS.inc()
@@ -569,6 +597,9 @@ class ServingEngine:
                     "before it completed")
                 req.finish_t = time.perf_counter()
                 req.done.set()
+                _ttrace.async_event("request", "serving.request", "e",
+                                    req.rid, tokens=len(req.outputs),
+                                    error="stopped")
             _G_QUEUE.set(0)
             _G_ACTIVE.set(0)
             _G_FREE_BLOCKS.set(self.cache.free_blocks)

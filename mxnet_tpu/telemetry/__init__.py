@@ -1,15 +1,32 @@
 """mx.telemetry — unified runtime observability.
 
-Three pieces (ISSUE 1 tentpole; reference anchors: src/profiler/profiler.cc
-Chrome-trace writer + aggregate_stats.cc per-op table):
+Per-process pieces (ISSUE 1 tentpole; reference anchors:
+src/profiler/profiler.cc Chrome-trace writer + aggregate_stats.cc per-op
+table):
 
 - **spans** (`tracer`) — ``telemetry.span(name, category, **attrs)`` context
   manager recording begin/end host timestamps into a ring buffer;
   ``chrome_trace()`` exports genuine Chrome-trace JSON (``traceEvents`` with
-  ``ph:"X"``, ``pid``/``tid``, ``cat``, ``args``) for chrome://tracing.
+  ``ph:"X"``, ``pid``/``tid``, ``cat``, ``args``, ``process_name``/
+  ``thread_name`` metadata) for chrome://tracing / Perfetto.
 - **metrics** (`metrics`) — process-global Counter/Gauge/Histogram registry
-  with Prometheus-text and JSON exporters.
+  (optionally labeled) with Prometheus-text and JSON exporters.
 - **ledger** (`ledger`) — the per-op aggregate table mx.profiler renders.
+
+The distributed observability plane (ISSUE 10) sits on top:
+
+- **aggregate** — cross-process collection-dir protocol
+  (``MXNET_TELEMETRY_DIR``): rank-tagged snapshot export at exit, merged
+  Chrome trace (pid=rank) + merged Prometheus snapshot on rank 0 /
+  ``tools/telemetry_report.py``; decode-pool workers ship counters back
+  on their task-ack channel.
+- **stepclock** — per-step data_wait/h2d/compute/comms/optimizer
+  attribution from Trainer/TrainStep, ``mxnet_step_phase_seconds{phase=}``
+  histograms, and the rolling input-/comms-/compute-bound verdict
+  rendered by ``telemetry.report()``.
+- **flightrec** — the always-on crash black box: bounded postmortem dumps
+  on unhandled exceptions, deadline-exceeded, chaos exits, SIGTERM, and
+  SIGUSR2 (``MXNET_FLIGHTREC*`` knobs).
 
 Instrumentation ships wired into the runtime chokepoints: op dispatch
 (ops.registry), kvstore push/pull/allreduce, gluon.Trainer step phases,
@@ -27,11 +44,15 @@ from __future__ import annotations
 
 from .. import config
 from . import ledger, metrics, tracer
+from . import stepclock          # noqa: E402 — needs metrics loaded
+from . import aggregate          # noqa: E402 — needs tracer/metrics/stepclock
+from . import flightrec          # noqa: E402 — needs aggregate
 from .ledger import record_op
 from .metrics import (  # noqa: F401
     DEFAULT_BUCKETS, REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
     counter, gauge, histogram, to_json, to_prometheus,
 )
+from .stepclock import STEP_CLOCK, StepClock, report  # noqa: F401
 from .tracer import (  # noqa: F401
     NULL_SPAN, Span, Tracer, chrome_trace, disable, enable, enabled,
     get_tracer, instant, span,
@@ -45,6 +66,8 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "record_op", "record_dispatch", "ledger", "metrics", "tracer",
     "env_enabled",
+    "aggregate", "flightrec", "stepclock", "StepClock", "STEP_CLOCK",
+    "report",
 ]
 
 # -- dispatch instrumentation (fed by ops.registry.invoke) -------------------
@@ -72,10 +95,11 @@ def record_dispatch(name, begin_ns, end_ns, hook_ns=0):
 
 
 def clear():
-    """Drop buffered trace events and ledger rows (metrics keep counting —
-    use REGISTRY.reset() to zero them)."""
+    """Drop buffered trace events, ledger rows, and the step-clock window
+    (metrics keep counting — use REGISTRY.reset() to zero them)."""
     tracer.clear()
     ledger.clear()
+    stepclock.STEP_CLOCK.reset()
 
 
 def payload_bytes(value):
@@ -100,6 +124,14 @@ def payload_bytes(value):
 _ENV_ENABLED = bool(config.get_int("MXNET_TELEMETRY", 0))
 if _ENV_ENABLED:
     enable()
+
+# observability plane (ISSUE 10): the flight recorder arms at import
+# (always-on black box) and, with a collection dir configured, every
+# process exports its rank-tagged telemetry shard at exit.
+if config.get_int("MXNET_FLIGHTREC", 1):
+    flightrec.install()
+if config.get("MXNET_TELEMETRY_DIR"):
+    aggregate.install_atexit()
 
 
 def env_enabled():

@@ -2,12 +2,21 @@
 
 The machine-readable half of mx.telemetry (the reference's
 ``aggregate_stats.cc`` table is human-only).  Metrics are process-global,
-get-or-create by name, thread-safe, and export in two forms:
+get-or-create by (name, labels), thread-safe, and export in two forms:
 
 - ``to_prometheus()`` — the Prometheus text exposition format (``# HELP`` /
   ``# TYPE`` lines, ``_bucket{le="..."}`` cumulative histogram rows), so a
   scrape endpoint or a log line is one call away;
 - ``to_json()`` — a plain dict for programmatic assertions and BENCH_* runs.
+
+Labels (ISSUE 10): a metric may carry a fixed label set
+(``histogram("mxnet_step_phase_seconds", labels={"phase": "comms"})``) —
+each label combination is its own time series under one exported metric
+name, with label values escaped per the exposition format (backslash,
+double-quote, newline) and rows emitted in a stable (name, labels) order.
+``export_state()``/``Histogram._absorb`` are the merge protocol the
+cross-process aggregation plane (telemetry.aggregate) rides: counters and
+histogram buckets sum across ranks, gauges sum (they are per-rank depths).
 
 Stdlib-only; safe to import anywhere.
 """
@@ -16,6 +25,7 @@ from __future__ import annotations
 
 import bisect
 import json
+import math
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
@@ -30,15 +40,52 @@ DEFAULT_BUCKETS = (
 )
 
 
+def _labels_key(labels):
+    """Canonical hashable form of a label set: sorted (k, v) str pairs."""
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in dict(labels).items()))
+
+
+def _escape_label_value(v):
+    """Prometheus text-format label-value escaping: backslash, quote,
+    newline (in that order — escaping the escape char first)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_str(labels, extra=()):
+    """``{k="v",...}`` rendering of a labels tuple (+ trailing pairs like
+    ``le``); empty string when there are no labels at all."""
+    items = list(labels) + list(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in items)
+    return "{" + body + "}"
+
+
+def _norm_buckets(buckets):
+    """Histogram bound normalization: floats, deduplicated, ascending,
+    non-finite bounds dropped (the +Inf bucket is ALWAYS implicit — an
+    explicit inf bound would render a duplicate +Inf row)."""
+    bounds = tuple(sorted({float(b) for b in buckets
+                           if math.isfinite(float(b))}))
+    if not bounds:
+        raise ValueError("histogram needs at least one finite bucket "
+                         "boundary")
+    return bounds
+
+
 class Counter:
     """Monotonically increasing count (ops dispatched, bytes moved)."""
 
     kind = "counter"
-    __slots__ = ("name", "help", "_value", "_lock")
+    __slots__ = ("name", "help", "labels", "_value", "_lock")
 
-    def __init__(self, name, help=""):  # noqa: A002 — prometheus field name
+    def __init__(self, name, help="", labels=None):  # noqa: A002
         self.name = name
         self.help = help
+        self.labels = _labels_key(labels)
         self._value = 0
         self._lock = threading.Lock()
 
@@ -57,21 +104,23 @@ class Counter:
             self._value = 0
 
     def snapshot(self):
-        return {"type": self.kind, "help": self.help, "value": self._value}
+        return {"type": self.kind, "help": self.help,
+                "labels": dict(self.labels), "value": self._value}
 
     def render(self, lines):
-        lines.append(f"{self.name} {self._value}")
+        lines.append(f"{self.name}{_label_str(self.labels)} {self._value}")
 
 
 class Gauge:
     """Point-in-time value (queue depth, loss scale)."""
 
     kind = "gauge"
-    __slots__ = ("name", "help", "_value", "_lock")
+    __slots__ = ("name", "help", "labels", "_value", "_lock")
 
-    def __init__(self, name, help=""):  # noqa: A002
+    def __init__(self, name, help="", labels=None):  # noqa: A002
         self.name = name
         self.help = help
+        self.labels = _labels_key(labels)
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -96,31 +145,33 @@ class Gauge:
             self._value = 0.0
 
     def snapshot(self):
-        return {"type": self.kind, "help": self.help, "value": self._value}
+        return {"type": self.kind, "help": self.help,
+                "labels": dict(self.labels), "value": self._value}
 
     def render(self, lines):
-        lines.append(f"{self.name} {self._value}")
+        lines.append(f"{self.name}{_label_str(self.labels)} {self._value}")
 
 
 class Histogram:
     """Distribution over fixed bucket boundaries (latency histograms).
 
     ``buckets`` are upper bounds in ascending order; an implicit +Inf bucket
-    catches the tail.  Export follows Prometheus cumulative-bucket semantics.
+    catches the tail (always emitted, exactly once — explicit non-finite
+    bounds are normalized away).  Export follows Prometheus cumulative-bucket
+    semantics.
     """
 
     kind = "histogram"
-    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_count",
-                 "_lock")
+    __slots__ = ("name", "help", "labels", "buckets", "_counts", "_sum",
+                 "_count", "_lock")
 
-    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS):  # noqa: A002
+    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS,  # noqa: A002
+                 labels=None):
         self.name = name
         self.help = help
-        bounds = tuple(sorted(float(b) for b in buckets))
-        if not bounds:
-            raise ValueError("histogram needs at least one bucket boundary")
-        self.buckets = bounds
-        self._counts = [0] * (len(bounds) + 1)  # last slot = +Inf
+        self.labels = _labels_key(labels)
+        self.buckets = _norm_buckets(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)  # last slot = +Inf
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
@@ -146,6 +197,26 @@ class Histogram:
             self._sum = 0.0
             self._count = 0
 
+    def _raw(self):
+        """(bounds, per-bucket counts incl. +Inf tail, sum, count) — the
+        mergeable form telemetry.aggregate ships across processes."""
+        with self._lock:
+            return self.buckets, list(self._counts), self._sum, self._count
+
+    def _absorb(self, bounds, counts, sum_, count):
+        """Fold another process's raw state in.  Bounds are expected to
+        match (same code, same registration); on drift the observations
+        land in the +Inf tail so the count/sum stay truthful."""
+        with self._lock:
+            if tuple(float(b) for b in bounds) == self.buckets \
+                    and len(counts) == len(self._counts):
+                for i, c in enumerate(counts):
+                    self._counts[i] += int(c)
+            else:
+                self._counts[-1] += int(count)
+            self._sum += float(sum_)
+            self._count += int(count)
+
     def snapshot(self):
         with self._lock:
             counts = list(self._counts)
@@ -154,58 +225,84 @@ class Histogram:
         for bound, c in zip(self.buckets, counts):
             cum += c
             buckets[bound] = cum
-        return {"type": self.kind, "help": self.help, "buckets": buckets,
+        return {"type": self.kind, "help": self.help,
+                "labels": dict(self.labels), "buckets": buckets,
                 "sum": ssum, "count": total}
 
     def render(self, lines):
         snap = self.snapshot()
         for bound, cum in snap["buckets"].items():
-            lines.append(f'{self.name}_bucket{{le="{bound:g}"}} {cum}')
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {snap["count"]}')
-        lines.append(f"{self.name}_sum {snap['sum']}")
-        lines.append(f"{self.name}_count {snap['count']}")
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_label_str(self.labels, (('le', f'{bound:g}'),))} {cum}")
+        lines.append(
+            f"{self.name}_bucket"
+            f"{_label_str(self.labels, (('le', '+Inf'),))} {snap['count']}")
+        lines.append(
+            f"{self.name}_sum{_label_str(self.labels)} {snap['sum']}")
+        lines.append(
+            f"{self.name}_count{_label_str(self.labels)} {snap['count']}")
 
 
 class MetricsRegistry:
-    """Get-or-create home for all metrics; one per process by default."""
+    """Get-or-create home for all metrics; one per process by default.
+
+    Keyed by (name, labels): one metric name may carry several label
+    combinations (each its own series) but exactly one kind.
+    """
 
     def __init__(self):
         self._metrics: dict = {}
+        self._kinds: dict = {}
         self._lock = threading.Lock()
 
-    def _get_or_create(self, cls, name, help, **kwargs):  # noqa: A002
+    def _get_or_create(self, cls, name, help, labels=None, **kwargs):  # noqa: A002
+        lk = _labels_key(labels)
+        key = (name, lk)
         with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = cls(name, help, **kwargs)
-                self._metrics[name] = m
-            elif not isinstance(m, cls):
+            kind = self._kinds.get(name)
+            if kind is not None and kind != cls.kind:
                 raise TypeError(
-                    f"metric {name!r} already registered as {m.kind}, "
+                    f"metric {name!r} already registered as {kind}, "
                     f"requested {cls.kind}")
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, help, labels=labels, **kwargs)
+                self._metrics[key] = m
+                self._kinds[name] = cls.kind
             return m
 
-    def counter(self, name, help=""):  # noqa: A002
-        return self._get_or_create(Counter, name, help)
+    def counter(self, name, help="", labels=None):  # noqa: A002
+        return self._get_or_create(Counter, name, help, labels=labels)
 
-    def gauge(self, name, help=""):  # noqa: A002
-        return self._get_or_create(Gauge, name, help)
+    def gauge(self, name, help="", labels=None):  # noqa: A002
+        return self._get_or_create(Gauge, name, help, labels=labels)
 
-    def histogram(self, name, help="", buckets=DEFAULT_BUCKETS):  # noqa: A002
-        h = self._get_or_create(Histogram, name, help, buckets=buckets)
-        want = tuple(sorted(float(b) for b in buckets))
+    def histogram(self, name, help="", buckets=DEFAULT_BUCKETS,  # noqa: A002
+                  labels=None):
+        h = self._get_or_create(Histogram, name, help, labels=labels,
+                                buckets=buckets)
+        want = _norm_buckets(buckets)
         if h.buckets != want:
             raise ValueError(
                 f"histogram {name!r} already registered with buckets "
                 f"{h.buckets}, requested {want}")
         return h
 
-    def get(self, name):
-        return self._metrics.get(name)
+    def get(self, name, labels=None):
+        return self._metrics.get((name, _labels_key(labels)))
 
     def collect(self):
+        """All metrics in stable (name, labels) order."""
         with self._lock:
             return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def all_metrics(self):
+        """All metrics, registration order (no sort) — for per-call scans
+        on hot paths (the decode-pool ack channel) where render order is
+        irrelevant."""
+        with self._lock:
+            return list(self._metrics.values())
 
     def reset(self):
         """Zero every metric in place (handles stay valid — instrumented
@@ -215,31 +312,52 @@ class MetricsRegistry:
 
     def to_prometheus(self):
         lines = []
+        last_name = None
         for m in self.collect():
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
+            if m.name != last_name:   # HELP/TYPE once per name, not per row
+                if m.help:
+                    lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# TYPE {m.name} {m.kind}")
+                last_name = m.name
             m.render(lines)
         return "\n".join(lines) + ("\n" if lines else "")
 
     def to_json(self, indent=None):
-        return json.dumps({m.name: m.snapshot() for m in self.collect()},
-                          indent=indent, sort_keys=True)
+        out = {}
+        for m in self.collect():
+            out[m.name + _label_str(m.labels)] = m.snapshot()
+        return json.dumps(out, indent=indent, sort_keys=True)
+
+    def export_state(self):
+        """Mergeable dump of every metric — the wire format of the
+        cross-process aggregation protocol (telemetry.aggregate)."""
+        out = []
+        for m in self.collect():
+            e = {"name": m.name, "labels": dict(m.labels), "kind": m.kind,
+                 "help": m.help}
+            if isinstance(m, Histogram):
+                bounds, counts, ssum, count = m._raw()
+                e.update(bounds=list(bounds), counts=counts, sum=ssum,
+                         count=count)
+            else:
+                e["value"] = m.value
+            out.append(e)
+        return out
 
 
 REGISTRY = MetricsRegistry()
 
 
-def counter(name, help=""):  # noqa: A002
-    return REGISTRY.counter(name, help)
+def counter(name, help="", labels=None):  # noqa: A002
+    return REGISTRY.counter(name, help, labels=labels)
 
 
-def gauge(name, help=""):  # noqa: A002
-    return REGISTRY.gauge(name, help)
+def gauge(name, help="", labels=None):  # noqa: A002
+    return REGISTRY.gauge(name, help, labels=labels)
 
 
-def histogram(name, help="", buckets=DEFAULT_BUCKETS):  # noqa: A002
-    return REGISTRY.histogram(name, help, buckets=buckets)
+def histogram(name, help="", buckets=DEFAULT_BUCKETS, labels=None):  # noqa: A002
+    return REGISTRY.histogram(name, help, buckets=buckets, labels=labels)
 
 
 def to_prometheus():

@@ -22,8 +22,8 @@ from collections import deque
 
 from .. import config
 
-__all__ = ["Span", "Tracer", "span", "instant", "enable", "disable",
-           "enabled", "get_tracer", "clear", "chrome_trace"]
+__all__ = ["Span", "Tracer", "span", "instant", "async_event", "enable",
+           "disable", "enabled", "get_tracer", "clear", "chrome_trace"]
 
 # Single flag gating ALL recording.  Rebound by enable()/disable(); hot
 # paths read it as a module attribute (one load, no call).
@@ -101,10 +101,38 @@ class Tracer:
         self._pid = os.getpid()
         self._t0_ns = time.perf_counter_ns()
         self._dropped = 0
+        self._tid_names: dict = {}
+        self._process_label = "mxnet_tpu"
 
     @property
     def capacity(self):
         return self._events.maxlen
+
+    @property
+    def wall_anchor_us(self):
+        """Wall-clock (unix epoch) microseconds of this tracer's ``ts==0``
+        origin — the anchor the cross-process merger uses to place every
+        rank's relative timestamps on one shared timeline."""
+        return (time.time_ns() - (time.perf_counter_ns() - self._t0_ns)) / 1e3
+
+    @property
+    def process_label(self):
+        return self._process_label
+
+    def set_process_label(self, label):
+        """Name this process carries in Chrome-trace ``process_name``
+        metadata (the dist kvstore sets ``mxnet_tpu rank N``)."""
+        with self._lock:
+            self._process_label = str(label)
+
+    def _push(self, ev):
+        with self._lock:
+            tid = ev["tid"]
+            if tid not in self._tid_names:
+                self._tid_names[tid] = threading.current_thread().name
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append(ev)
 
     def add_event(self, name, category, begin_ns, end_ns, attrs=None):
         """Record one complete ('X') event from raw perf_counter_ns stamps."""
@@ -119,10 +147,7 @@ class Tracer:
         }
         if attrs:
             ev["args"] = dict(attrs)
-        with self._lock:
-            if len(self._events) == self._events.maxlen:
-                self._dropped += 1
-            self._events.append(ev)
+        self._push(ev)
 
     def add_instant(self, name, category, attrs=None):
         """Record an instant ('i') event at now."""
@@ -137,10 +162,32 @@ class Tracer:
         }
         if attrs:
             ev["args"] = dict(attrs)
+        self._push(ev)
+
+    def add_async(self, name, category, ph, id_, attrs=None, ts_ns=None):
+        """Record one nestable async event (``ph`` in 'b'/'n'/'e') keyed by
+        ``id`` — Perfetto renders same-(cat, id) events as one linked span
+        tree, which is how serving requests thread queue → prefill →
+        decode iterations → finish across scheduler iterations."""
+        if ts_ns is None:
+            ts_ns = time.perf_counter_ns()
+        ev = {
+            "name": name,
+            "cat": category,
+            "ph": ph,
+            "id": str(id_),
+            "ts": (ts_ns - self._t0_ns) / 1e3,
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+        }
+        if attrs:
+            ev["args"] = dict(attrs)
+        self._push(ev)
+
+    def thread_names(self):
+        """{tid: thread name} for every thread that recorded an event."""
         with self._lock:
-            if len(self._events) == self._events.maxlen:
-                self._dropped += 1
-            self._events.append(ev)
+            return dict(self._tid_names)
 
     def events(self):
         with self._lock:
@@ -159,12 +206,19 @@ class Tracer:
         """The buffer as a Chrome-trace JSON object (a plain dict).
 
         ``extra_events`` lets callers (the profiler facade) merge additional
-        event lists into the same timeline.
+        event lists into the same timeline.  ``process_name`` and per-tid
+        ``thread_name`` metadata (``ph:"M"``) ride along so single- and
+        merged multi-rank traces are human-labeled in Perfetto.
         """
         events = [{
             "name": "process_name", "ph": "M", "pid": self._pid,
-            "args": {"name": "mxnet_tpu"},
+            "args": {"name": self._process_label},
         }]
+        for tid, tname in sorted(self.thread_names().items()):
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": self._pid,
+                "tid": tid, "args": {"name": tname},
+            })
         events.extend(self.events())
         if extra_events:
             events.extend(extra_events)
@@ -213,6 +267,12 @@ def instant(name, category="host", **attrs):
     """Zero-duration marker event."""
     if _ENABLED:
         _TRACER.add_instant(name, category, attrs)
+
+
+def async_event(name, category, ph, id_, **attrs):
+    """Flag-gated async ('b'/'n'/'e') event — request span trees."""
+    if _ENABLED:
+        _TRACER.add_async(name, category, ph, id_, attrs or None)
 
 
 def clear():

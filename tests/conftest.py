@@ -24,6 +24,15 @@ if os.environ.get("MXNET_TEST_DEVICE", "cpu") != "tpu":
     import jax
     jax.config.update("jax_platforms", "cpu")
 
+# The flight recorder (ISSUE 10) is always-on and several suites
+# deliberately trigger its dump conditions (deadline-exceeded, chaos
+# faults); point the dumps at a scratch dir so test runs don't litter the
+# repo root.  Tests that assert on dumps monkeypatch their own dir.
+if "MXNET_FLIGHTREC_DIR" not in os.environ:
+    import tempfile
+    os.environ["MXNET_FLIGHTREC_DIR"] = tempfile.mkdtemp(
+        prefix="mxnet-flightrec-")
+
 import numpy as np
 import pytest
 

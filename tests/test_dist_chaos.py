@@ -43,9 +43,18 @@ def _launch(mode, outdir, n=4, timeout=240):
     env = {
         "MXNET_TPU_JIT_IMPERATIVE": "1",
         # a dead peer must surface as KVStoreTimeoutError well before the
-        # launcher kill — this bound IS the no-hang assertion
-        "MXNET_KVSTORE_TIMEOUT_S": "20",
+        # launcher kill — this bound IS the no-hang assertion.  It must
+        # also undercut the launcher's 15s straggler grace: a rank still
+        # blocked in gloo when the grace expires is SIGKILLed, the one
+        # death even the flight recorder cannot observe
+        "MXNET_KVSTORE_TIMEOUT_S": "10",
         "MXNET_RESILIENCE_BACKOFF_S": "0.001",
+        # observability plane (ISSUE 10): telemetry on with a collection
+        # dir + flight-recorder dir, so every death leaves a postmortem
+        # and every rank leaves a mergeable telemetry shard
+        "MXNET_TELEMETRY": "1",
+        "MXNET_TELEMETRY_DIR": os.path.join(outdir, "telemetry"),
+        "MXNET_FLIGHTREC_DIR": os.path.join(outdir, "flightrec"),
     }
     t0 = time.monotonic()
     codes = launch_local(n, [sys.executable, worker, mode, outdir],
@@ -83,6 +92,45 @@ def test_n4_chaos_death_and_preemption_resume_bit_identical(tmp_path):
     assert all(c != 0 for c in codes), codes
     assert elapsed < 180, f"survivors hung {elapsed:.0f}s (deadline broken)"
     assert _committed_steps(chaotic) == [0, 1, 2]  # step 3 never committed
+
+    # ISSUE 10 acceptance: the death left per-rank flight-recorder dumps
+    # (rank 3 dumped inside the chaos 'exit', survivors on the blown
+    # deadline and/or the unhandled KVStoreTimeoutError), and rank 0 can
+    # render ONE merged Chrome trace + ONE merged Prometheus snapshot
+    # from the collection dir (dying/raising ranks export their shard
+    # through the flight recorder / atexit).
+    frdir = os.path.join(chaotic, "flightrec")
+    dumps = sorted(os.listdir(frdir))
+    dump_ranks = {int(f.split("-")[1][4:]) for f in dumps
+                  if f.startswith("flightrec-") and f.endswith(".json")}
+    assert dump_ranks == set(range(n)), (dump_ranks, dumps)
+    killer = [f for f in dumps if "chaos.exit.kvstore.allreduce" in f]
+    assert killer and f"rank{n - 1:05d}" in killer[0]
+    with open(os.path.join(frdir, killer[0])) as f:
+        rec = json.load(f)
+    assert rec["rank"] == n - 1
+    assert rec["chaos"]["faults_fired"] >= 1
+    assert any(e.get("cat") == "kvstore" for e in rec["spans"])
+
+    from mxnet_tpu.telemetry import aggregate
+    teldir = os.path.join(chaotic, "telemetry")
+    snaps = aggregate.load_snapshots(teldir)
+    assert [s["rank"] for s in snaps] == list(range(n))
+    trace = aggregate.merged_chrome_trace(snaps)
+    span_pids = {e["pid"] for e in trace["traceEvents"]
+                 if e.get("ph") == "X"}
+    assert span_pids == set(range(n))
+    # merged Prometheus snapshot: every rank's steps 0-2 moved bytes
+    # through the collective, so the rank-summed counter must exceed any
+    # single rank's (survivors may die on a fast gloo error OR the
+    # deadline — either way their shard reached the collection dir)
+    prom = aggregate.merged_prometheus(snaps)
+    row = [ln for ln in prom.splitlines()
+           if ln.startswith("mxnet_kvstore_allreduce_bytes_total")]
+    per_rank = [m["value"] for s in snaps for m in s["metrics"]
+                if m["name"] == "mxnet_kvstore_allreduce_bytes_total"]
+    assert len(per_rank) == n and all(v > 0 for v in per_rank)
+    assert float(row[0].split()[1]) == sum(per_rank)
 
     # 2. elastic restart replays step 3, then preemption mid-checkpoint
     #    at step 4: data written, manifest commit never reached

@@ -14,7 +14,7 @@ import sys
 import pytest
 
 
-def _run_sync_kvstore(n, timeout=180):
+def _run_sync_kvstore(n, timeout=180, env_extra=None):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, os.path.join(repo, "tools"))
     try:
@@ -29,6 +29,7 @@ def _run_sync_kvstore(n, timeout=180):
     # residual flake is the localhost coordinator rendezvous, which is
     # process-lifetime state a fresh launch resets.
     env = {"MXNET_TPU_JIT_IMPERATIVE": "1", "MXNET_KVSTORE_TIMEOUT_S": "60"}
+    env.update(env_extra or {})
     for attempt in range(2):
         codes = launch_local(n, [sys.executable, worker], env_extra=env,
                              cpu_devices_per_worker=1, timeout=timeout)
@@ -37,8 +38,35 @@ def _run_sync_kvstore(n, timeout=180):
     assert codes == [0] * n, f"worker exit codes {codes}"
 
 
-def test_two_process_sync_kvstore():
-    _run_sync_kvstore(2)
+def test_two_process_sync_kvstore(tmp_path):
+    """The exact-value dist body, with the ISSUE 10 aggregation plane
+    riding along: both workers run with telemetry on and a collection
+    dir, export rank-tagged snapshots at exit, and this (rank-0-role)
+    process merges them into ONE Chrome trace and ONE Prometheus
+    snapshot."""
+    teldir = str(tmp_path / "telemetry")
+    _run_sync_kvstore(2, env_extra={"MXNET_TELEMETRY": "1",
+                                    "MXNET_TELEMETRY_DIR": teldir})
+    from mxnet_tpu.telemetry import aggregate
+    snaps = aggregate.load_snapshots(teldir)
+    assert [s["rank"] for s in snaps] == [0, 1]
+    trace = aggregate.merged_chrome_trace(snaps)
+    evs = trace["traceEvents"]
+    labels = {e["args"]["name"] for e in evs
+              if e.get("name") == "process_name"}
+    assert {"mxnet_tpu rank 0", "mxnet_tpu rank 1"} <= labels
+    pids = {e["pid"] for e in evs
+            if e.get("ph") == "X" and e.get("cat") == "kvstore"}
+    assert pids == {0, 1}     # both ranks' kvstore spans, pid = rank
+    prom = aggregate.merged_prometheus(snaps)
+    merged = {ln.split()[0]: float(ln.split()[1])
+              for ln in prom.splitlines()
+              if ln.startswith("mxnet_kvstore_allreduce_bytes_total")}
+    per_rank = [
+        m["value"] for s in snaps for m in s["metrics"]
+        if m["name"] == "mxnet_kvstore_allreduce_bytes_total"]
+    assert len(per_rank) == 2 and all(v > 0 for v in per_rank)
+    assert merged["mxnet_kvstore_allreduce_bytes_total"] == sum(per_rank)
 
 
 @pytest.mark.slow

@@ -152,6 +152,26 @@ def test_pooled_decode_telemetry(rec_path):
         telemetry.disable()
 
 
+def test_pool_worker_counter_shipping(rec_path, monkeypatch):
+    """ISSUE 10: decode workers ship their counters back on the existing
+    ack channel.  Chaos armed at io.decode (delay, worker-side only)
+    increments the WORKER's fault counter; the parent's registry must see
+    those increments arrive through the (n, seconds, deltas) acks."""
+    monkeypatch.setenv("MXNET_CHAOS", "1")
+    monkeypatch.setenv("MXNET_CHAOS_SITES", "io.decode:delay:0:0.001")
+    faults = telemetry.REGISTRY.get("mxnet_resilience_faults_injected_total")
+    before = faults.value
+    it = _make_iter(rec_path, threads=2)
+    try:
+        n_batches = len(_epoch(it))
+        assert n_batches > 0
+        # one fault fires per decoded chunk, all inside worker processes;
+        # every ack's delta leg lands them in the parent's counter
+        assert faults.value - before >= n_batches
+    finally:
+        it.close()
+
+
 def test_dataloader_decode_pool_bit_identical(rec_path):
     """The gluon DataLoader routes a decode-aware dataset through the
     shared-memory pool when num_workers>0 — batches identical to

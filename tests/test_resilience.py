@@ -402,14 +402,17 @@ def test_sigterm_triggers_emergency_save_and_clean_stop(tmp_path):
             os.kill(os.getpid(), signal.SIGTERM)  # preemption notice
         return step < 50  # would run long — SIGTERM must stop it
 
+    prev_handler = signal.getsignal(signal.SIGTERM)
     with pytest.warns(UserWarning, match="SIGTERM"):
         last = mx.checkpoint.auto_resume(run, ckdir, net=net, trainer=tr,
                                          save_every=10)
     assert last == 2  # stopped at the preempted step, not 50
     mgr = mx.checkpoint.CheckpointManager(ckdir)
     assert mgr.latest_step() == 2  # emergency save happened off-cadence
-    # default SIGTERM disposition restored after auto_resume
-    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+    # prior SIGTERM disposition restored after auto_resume (SIG_DFL
+    # historically; the flight recorder's chaining dump handler since
+    # ISSUE 10 armed it at import)
+    assert signal.getsignal(signal.SIGTERM) == prev_handler
 
 
 def test_sigterm_during_fault_stops_without_replay(tmp_path):

@@ -390,3 +390,45 @@ def test_serving_telemetry_slos(llama_net):
     finally:
         if not telemetry.env_enabled():
             telemetry.disable()
+
+
+def test_serving_request_span_tree(llama_net):
+    """ISSUE 10: every request is a linked async span tree in the trace —
+    'b' at submit, 'n' markers at admission/first token, 'e' at finish,
+    all keyed by request id; prefill spans and decode-step spans carry
+    the rid linkage in their args."""
+    telemetry.enable()
+    telemetry.clear()
+    try:
+        eng = _llama_engine(llama_net)
+        h1, h2 = (eng.submit(p, max_new_tokens=4) for p in ([5, 6], [7, 8]))
+        eng.drain()
+        out1, out2 = h1.result(5), h2.result(5)
+        assert out1 and out2
+        evs = telemetry.get_tracer().events()
+        for h in (h1, h2):
+            rid = str(h.rid)
+            tree = [e for e in evs if e.get("cat") == "serving.request"
+                    and e.get("id") == rid]
+            phs = [e["ph"] for e in tree]
+            assert phs[0] == "b" and phs[-1] == "e"
+            marks = {e["name"] for e in tree if e["ph"] == "n"}
+            assert {"admitted", "first_token"} <= marks
+            end = tree[-1]
+            assert end["args"]["tokens"] == len(
+                (out1 if h is h1 else out2))
+            # the tree threads in timestamp order: queue -> ... -> finish
+            ts = [e["ts"] for e in tree]
+            assert ts == sorted(ts)
+        prefill_rids = {e["args"]["rid"] for e in evs
+                        if e.get("name") == "serving.prefill"}
+        assert {h1.rid, h2.rid} <= prefill_rids
+        decode_rids = set()
+        for e in evs:
+            if e.get("name") == "serving.decode_step":
+                decode_rids.update(e["args"]["rids"])
+        assert {h1.rid, h2.rid} <= decode_rids
+    finally:
+        telemetry.clear()
+        if not telemetry.env_enabled():
+            telemetry.disable()

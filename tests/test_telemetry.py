@@ -164,6 +164,81 @@ def test_histogram_buckets():
         telemetry.histogram("t_latency_seconds", buckets=(2.0,))
 
 
+def test_labeled_metrics_series_and_escaping():
+    """ISSUE 10 satellite: label support with exposition-format escaping.
+    One name may carry several label combinations (each its own series,
+    one HELP/TYPE header) and label values escape backslash/quote/newline."""
+    a = telemetry.counter("t_phase_total", "per-phase", labels={"phase": "io"})
+    b = telemetry.counter("t_phase_total", labels={"phase": "net"})
+    assert a is not b
+    assert telemetry.counter("t_phase_total", labels={"phase": "io"}) is a
+    a.inc(2)
+    b.inc(5)
+    text = telemetry.to_prometheus()
+    assert text.count("# TYPE t_phase_total counter") == 1
+    assert 't_phase_total{phase="io"} 2' in text
+    assert 't_phase_total{phase="net"} 5' in text
+    # stable ordering: the io series renders before net every time
+    assert text.index('phase="io"') < text.index('phase="net"')
+    assert text == telemetry.to_prometheus()
+    # escaping: backslash first, then quote, then newline
+    evil = telemetry.counter("t_evil_total",
+                             labels={"p": 'a"b\\c\nd'})
+    evil.inc()
+    assert 't_evil_total{p="a\\"b\\\\c\\nd"} 1' in telemetry.to_prometheus()
+    # kind conflicts are caught across label sets of the same name
+    with pytest.raises(TypeError):
+        telemetry.gauge("t_phase_total", labels={"phase": "other"})
+    # json keys carry the label suffix; unlabeled keys stay bare
+    data = json.loads(telemetry.to_json())
+    assert data['t_phase_total{phase="io"}']["value"] == 2
+    assert data['t_phase_total{phase="io"}']["labels"] == {"phase": "io"}
+
+
+def test_labeled_histogram_renders_le_with_labels():
+    h = telemetry.histogram("t_lab_seconds", buckets=(0.5,),
+                            labels={"phase": "x"})
+    h.observe(0.1)
+    text = telemetry.to_prometheus()
+    assert 't_lab_seconds_bucket{phase="x",le="0.5"} 1' in text
+    assert 't_lab_seconds_bucket{phase="x",le="+Inf"} 1' in text
+    assert 't_lab_seconds_sum{phase="x"} 0.1' in text
+    assert 't_lab_seconds_count{phase="x"} 1' in text
+
+
+def test_histogram_inf_bound_normalized():
+    """An explicit +Inf bound must not render a duplicate +Inf row: the
+    implicit tail bucket is THE +Inf bucket, emitted exactly once."""
+    h = telemetry.histogram("t_inf_seconds",
+                            buckets=(0.1, float("inf"), 0.5, 0.5))
+    assert h.buckets == (0.1, 0.5)   # dedup + inf dropped
+    h.observe(9.0)
+    text = telemetry.to_prometheus()
+    assert text.count('t_inf_seconds_bucket{le="+Inf"}') == 1
+    assert 't_inf_seconds_bucket{le="+Inf"} 1' in text
+    with pytest.raises(ValueError):
+        telemetry.histogram("t_only_inf", buckets=(float("inf"),))
+
+
+def test_histogram_absorb_merges_raw_counts():
+    h1 = telemetry.Histogram("m", buckets=(0.1, 1.0))
+    h2 = telemetry.Histogram("m", buckets=(0.1, 1.0))
+    h1.observe(0.05)
+    h2.observe(0.5)
+    h2.observe(5.0)
+    h1._absorb(*h2._raw())
+    snap = h1.snapshot()
+    assert snap["count"] == 3
+    assert snap["buckets"] == {0.1: 1, 1.0: 2}
+    assert snap["sum"] == pytest.approx(5.55)
+    # mismatched bounds: count/sum stay truthful via the +Inf tail
+    h3 = telemetry.Histogram("m", buckets=(7.0,))
+    h3.observe(1.0)
+    h1._absorb(*h3._raw())
+    snap = h1.snapshot()
+    assert snap["count"] == 4 and snap["buckets"] == {0.1: 1, 1.0: 2}
+
+
 def test_prometheus_and_json_export():
     telemetry.counter("t_ops_total", "ops").inc(7)
     telemetry.histogram("t_seconds", "lat", buckets=(0.5,)).observe(0.1)
