@@ -77,6 +77,14 @@ HOT_PATHS = {
     # ack channel — both must stay host-sync-free and flag-disciplined
     "telemetry/stepclock.py": {"begin_step", "note", "end_step"},
     "telemetry/aggregate.py": {"counter_deltas", "absorb_counter_deltas"},
+    # elastic control plane (ISSUE 11): the controller's monitor loop
+    # polls several times a second and the heartbeat note sits on the
+    # worker's step path — both must stay host-sync-free and
+    # flag-disciplined
+    "resilience/controller.py": {"_watch_loop", "_poll_workers",
+                                 "_read_heartbeats", "_check_hangs",
+                                 "_check_straggler", "_manifest_latest"},
+    "resilience/heartbeat.py": {"set_step", "beat", "_beater"},
 }
 
 # GC05 additionally audits these (they sit on the per-batch/per-call path

@@ -50,6 +50,10 @@ _M_CORRUPT = _tel.counter(
     "mxnet_checkpoint_corrupt_steps_total",
     "Checkpoint steps that failed to restore and were skipped by the "
     "fall-back-to-previous policy.")
+_M_RESIZE_RESTORES = _tel.counter(
+    "mxnet_checkpoint_resize_restores_total",
+    "Restores where the current world size differs from the world that "
+    "saved the step (elastic resume with a different n).")
 
 
 def _ocp():
@@ -120,29 +124,60 @@ class CheckpointManager:
         return out
 
     # -- commit manifest (atomicity layer) ----------------------------------
-    def _read_manifest(self):
-        """Committed step list, or None when absent/unreadable (pre-manifest
-        directories fall back to the backend's view)."""
+    def _read_manifest_data(self):
+        """Raw manifest dict, or None when absent/unreadable."""
         try:
             with open(self._manifest_path) as f:
                 data = json.load(f)
         except (FileNotFoundError, ValueError, OSError):
             return None
-        steps = data.get("committed")
-        if not isinstance(steps, list):
+        if not isinstance(data, dict) \
+                or not isinstance(data.get("committed"), list):
             return None
-        return sorted(int(s) for s in steps)
+        return data
 
-    def _write_manifest(self, committed):
+    @staticmethod
+    def _steps_of(data):
+        """Sorted committed steps of a manifest dict (the one parser)."""
+        return sorted(int(s) for s in data["committed"])
+
+    def _read_manifest(self):
+        """Committed step list, or None when absent/unreadable (pre-manifest
+        directories fall back to the backend's view)."""
+        data = self._read_manifest_data()
+        if data is None:
+            return None
+        return self._steps_of(data)
+
+    def _write_manifest(self, committed, world=None):
         """Atomic write-then-rename (satellite: non-atomic checkpoint
         writes): a kill at ANY point leaves either the old manifest or the
-        new one, never a half-written file."""
+        new one, never a half-written file.  ``world`` maps step →
+        {n, sharded}: the world size that committed each step, which is
+        what the resume-with-different-n audit checks at restore."""
+        doc = {"committed": sorted(int(s) for s in committed)}
+        if world:
+            doc["world"] = {str(int(s)): world[s] for s in world
+                            if int(s) in set(doc["committed"])}
         tmp = f"{self._manifest_path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            json.dump({"committed": sorted(int(s) for s in committed)}, f)
+            json.dump(doc, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._manifest_path)
+
+    def _world_entry(self, step):
+        """The manifest's {n, sharded} record for ``step``, or None for
+        pre-audit manifests (the one parser of that schema)."""
+        data = self._read_manifest_data() or {}
+        entry = (data.get("world") or {}).get(str(int(step)))
+        return entry if isinstance(entry, dict) and "n" in entry else None
+
+    def world_size(self, step):
+        """World size (process count) that committed ``step``, or None
+        for pre-audit manifests."""
+        entry = self._world_entry(step)
+        return int(entry["n"]) if entry else None
 
     def committed_steps(self):
         """Steps that finished their save AND their manifest commit,
@@ -188,9 +223,11 @@ class CheckpointManager:
         # all_steps() is a checkpoint-dir listing — a network round-trip
         # on cloud storage; save_every=1 pays this per training step)
         on_disk = set(self._mgr.all_steps())
-        manifest = self._read_manifest()
+        mdata = self._read_manifest_data()
+        manifest = self._steps_of(mdata) if mdata is not None else None
         committed = set(s for s in manifest if s in on_disk) \
             if manifest is not None else set(on_disk)
+        world_map = dict((mdata or {}).get("world") or {})
         if step in on_disk and step not in committed:
             # orphaned step directory from a save killed before its
             # manifest commit: clear it so the replayed save can land
@@ -206,6 +243,18 @@ class CheckpointManager:
                 _chaos.hit("checkpoint.save", step=step)
             if saved:
                 committed.add(step)
+                # resume-with-different-n audit (ISSUE 11): record the
+                # world that committed this step, and whether its arrays
+                # are topology-free (gather-on-save) or world-sharded
+                try:
+                    import jax
+                    nproc = jax.process_count()
+                except Exception:  # noqa: BLE001 — extra-only save, no jax
+                    nproc = 1
+                world_map[str(step)] = {
+                    "n": nproc,
+                    "sharded": bool(config.get_int(
+                        "MXNET_CHECKPOINT_SHARDED", 0))}
                 # predict the backend's max_to_keep pruning (newest kept)
                 # from the pre-save snapshot instead of re-listing the
                 # directory; committed_steps() re-intersects with the real
@@ -214,7 +263,7 @@ class CheckpointManager:
                 if self._keep:
                     retained = sorted(on_disk | {step})[-self._keep:]
                     committed &= set(retained)
-                self._write_manifest(committed)
+                self._write_manifest(committed, world_map)
         if sp is not _tel.NULL_SPAN:
             _M_SAVE_SECONDS.observe(sp.duration_s)
         return bool(saved)
@@ -248,10 +297,51 @@ class CheckpointManager:
             f"no restorable checkpoint in {self._dir}: every committed "
             f"step {list(reversed(candidates))} failed") from last_exc
 
+    def _audit_world(self, step):
+        """Resume-with-different-n audit (ISSUE 11): an elastic restart
+        restores at a world size other than the one that saved.  For
+        gather-on-save checkpoints that is by construction safe (host
+        arrays, topology-free); the event is still counted and warned so
+        resize points stay visible in the trajectory record.  A
+        world-SHARDED save restoring elsewhere gets a louder warning —
+        elastic jobs should save with MXNET_CHECKPOINT_SHARDED=0."""
+        entry = self._world_entry(step)
+        if entry is None:
+            return
+        saved_n = int(entry["n"])
+        try:
+            import jax
+            cur_n = jax.process_count()
+        except Exception:  # noqa: BLE001 — jax-free restore path
+            cur_n = 1
+        if saved_n == cur_n:
+            return
+        import warnings
+        _M_RESIZE_RESTORES.inc()
+        _tel.instant("checkpoint.resize_restore", "resilience", step=step,
+                     saved_world=saved_n, world=cur_n)
+        if entry.get("sharded"):
+            warnings.warn(
+                f"checkpoint step {step} was SHARDED-saved by a world of "
+                f"{saved_n} and is restoring into a world of {cur_n}; "
+                "sharded layouts are topology-bound — elastic jobs "
+                "should save topology-free (MXNET_CHECKPOINT_SHARDED=0, "
+                "gather-on-save)", stacklevel=3)
+        else:
+            warnings.warn(
+                f"elastic resize point: checkpoint step {step} was saved "
+                f"by a world of {saved_n}, restoring into a world of "
+                f"{cur_n} (topology-free gather-on-save checkpoint — "
+                "parameters are world-independent)", stacklevel=3)
+
     def _restore_step(self, step, net=None, trainer=None):
         ocp = _ocp()
         with _tel.span("checkpoint.restore", "checkpoint", step=step) as sp:
             tree = self._mgr.restore(step, args=ocp.args.StandardRestore())
+            # audit only once the step actually restored: a corrupt
+            # candidate the fallback loop skips must not warn/count as
+            # a resize point it never became
+            self._audit_world(step)
             if net is not None:
                 params = net.collect_params()
                 saved = tree.get("params", {})

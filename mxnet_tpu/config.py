@@ -80,7 +80,9 @@ KNOWN_VARS = {
     "MXNET_FLIGHTREC_DIR": (
         None, str,
         "Directory for flight-recorder dumps (default: MXNET_TELEMETRY_DIR "
-        "when set, else ./flightrec)."),
+        "when set, else ~/.cache/mxnet_tpu/flightrec — never the working "
+        "tree; spawned workers inherit the env so one job-wide redirect "
+        "covers every rank)."),
     "MXNET_FLIGHTREC_SPANS": (
         "256", int,
         "Most-recent trace events included in each flight-recorder dump."),
@@ -213,6 +215,60 @@ KNOWN_VARS = {
         "1", int, "World size the dist kvstore rendezvous waits for."),
     "MXNET_DIST_RANK": (
         "0", int, "This worker's process id in the dist kvstore world."),
+    # elastic controller (ISSUE 11: resilience/controller.py +
+    # tools/elastic_launch.py; the *_DIR/INCARNATION/WORLD_TARGET vars are
+    # WRITTEN by the controller into each worker's env)
+    "MXNET_ELASTIC_MIN_WORKERS": (
+        "1", int,
+        "Smallest world size the elastic controller will shrink to on "
+        "worker death before restarting at the same size."),
+    "MXNET_ELASTIC_MAX_RESTARTS": (
+        "8", int,
+        "Unplanned whole-job restarts the controller performs before "
+        "declaring the job dead (planned grow-backs are free); each "
+        "burns a Retry-policy exponential backoff."),
+    "MXNET_ELASTIC_REGROW_STEPS": (
+        "0", int,
+        "Committed checkpoint steps a DEGRADED (shrunk) incarnation must "
+        "add before the controller drains it and grows back to the "
+        "target world.  0 = never grow back automatically."),
+    "MXNET_ELASTIC_HEARTBEAT_S": (
+        "2", float,
+        "Worker heartbeat interval (resilience.heartbeat daemon thread; "
+        "started by the dist kvstore at bring-up when a heartbeat dir "
+        "is configured)."),
+    "MXNET_ELASTIC_HEARTBEAT_DIR": (
+        None, str,
+        "Directory of per-rank heartbeat files (hb-rank<R>.json, atomic "
+        "rewrites).  The elastic controller injects one per incarnation; "
+        "unset = heartbeats off."),
+    "MXNET_ELASTIC_HANG_S": (
+        "60", float,
+        "Heartbeat staleness after which the controller declares a "
+        "worker hung and SIGKILLs it (a wedged rank holds every peer "
+        "hostage inside the collective).  0 disables hang detection."),
+    "MXNET_ELASTIC_STRAGGLER_FACTOR": (
+        "0", float,
+        "Straggler threshold fed by the stepclock verdicts in the "
+        "heartbeats: when every peer is comms-bound and exactly one "
+        "rank is not, and its compute median exceeds this factor times "
+        "the fastest peer's, the controller kills it and resizes.  "
+        "0 (default) disables straggler mitigation."),
+    "MXNET_ELASTIC_GRACE_S": (
+        "10", float,
+        "Drain grace: seconds between the controller's SIGTERM (the "
+        "preemption-save path) and SIGKILL when stopping workers."),
+    "MXNET_ELASTIC_INCARNATION": (
+        "0", int,
+        "Job incarnation counter the controller injects per (re)start; "
+        "workers use it to scope restart-once behaviors and the "
+        "heartbeat/flightrec records carry it."),
+    "MXNET_ELASTIC_WORLD_TARGET": (
+        None, int,
+        "The job's TARGET world size, fixed across resizes (injected by "
+        "the controller).  Workers shard a fixed data space over it so "
+        "training math is world-size-independent; unset = current "
+        "world."),
     # optimizer aggregation (reference MXNET_OPTIMIZER_AGGREGATION_SIZE)
     "MXNET_OPTIMIZER_AGGREGATION_SIZE": (
         "4", int,

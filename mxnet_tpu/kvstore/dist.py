@@ -30,6 +30,7 @@ from .. import ndarray as nd
 from .. import telemetry as _tel
 from ..resilience import Deadline, KVStoreTimeoutError, Retry
 from ..resilience import chaos as _chaos
+from ..resilience import heartbeat as _hb
 from .local import KVStoreLocal
 
 # registry get-or-create: same handles local.py registered
@@ -146,6 +147,16 @@ class KVStoreDistTPUSync(KVStoreLocal):
         if self._initialized:
             return
         import jax
+        # elastic liveness (ISSUE 11): under the elastic controller the
+        # heartbeat dir is injected per incarnation — start beating
+        # BEFORE the rendezvous so even bring-up time is observable, and
+        # walk the phase to 'running' once the world forms.  The rank in
+        # each beat is re-read from the (re-numbered) MXNET_DIST_RANK of
+        # THIS incarnation, so a restarted survivor reports its new rank.
+        hb_on = _hb.enabled()
+        if hb_on:
+            _hb.start()
+            _hb.set_phase("bringup")
         # Under a pod launcher these env vars are set (tools/launch.py analog
         # writes them); single-process fallback keeps tests runnable anywhere.
         coord = config.get("MXNET_DIST_COORDINATOR") \
@@ -181,6 +192,13 @@ class KVStoreDistTPUSync(KVStoreLocal):
                 elif "timed out" in msg or "timeout" in msg \
                         or "deadline" in msg:
                     _tel.flightrec.dump("deadline.dist.bringup", exc=e)
+                    # surface the bring-up failure to the elastic
+                    # controller: a 'failed' heartbeat BEFORE 'running'
+                    # classifies this as a rendezvous problem, which
+                    # restarts at the SAME world size (no rank died)
+                    _hb.mark_failed(
+                        f"bringup-timeout: rank {rank}/{nproc} at {coord} "
+                        f"after {t:g}s")
                     raise KVStoreTimeoutError(
                         f"distributed bring-up: rank {rank} could not "
                         f"rendezvous with all {nproc} workers at {coord} "
@@ -189,6 +207,8 @@ class KVStoreDistTPUSync(KVStoreLocal):
                 else:
                     raise
             if nproc > 1 and jax.process_count() == 1:
+                _hb.mark_failed("bringup-failed: backend initialized "
+                                "before the dist kvstore")
                 raise MXNetError(
                     f"distributed bring-up: MXNET_DIST_NUM_WORKERS={nproc} "
                     "but the process group never formed (the jax backend "
@@ -196,6 +216,8 @@ class KVStoreDistTPUSync(KVStoreLocal):
                     "kvstore — or call jax.distributed.initialize — before "
                     "any array/computation touches the backend.")
         self._initialized = True
+        if hb_on:
+            _hb.set_phase("running")
         # rank-tag this process's telemetry (ISSUE 10): snapshots exported
         # into MXNET_TELEMETRY_DIR and flight-recorder dumps carry the
         # rank, and rank 0 merges them into one job-wide view

@@ -36,6 +36,9 @@ from .policies import (  # noqa: F401
 from .chaos import (  # noqa: F401
     ChaosError, ChaosTransientError, ChaosWorkerDeath,
 )
+from . import heartbeat  # noqa: F401  (worker-side liveness protocol)
+from . import controller  # noqa: F401
+from .controller import ElasticController, JobFailedError  # noqa: F401
 
 __all__ = [
     "Retry", "Deadline", "protect", "is_transient",
@@ -43,6 +46,7 @@ __all__ = [
     "KVStoreTimeoutError",
     "ChaosError", "ChaosTransientError", "ChaosWorkerDeath",
     "chaos", "policies", "record_fallback", "record_resume",
+    "heartbeat", "controller", "ElasticController", "JobFailedError",
 ]
 
 # shared recovery counters (the per-policy ones live in policies.py)

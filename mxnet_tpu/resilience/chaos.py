@@ -47,8 +47,14 @@ __all__ = [
 # ``io.decode`` fires INSIDE a decode-pool worker process (io/pipeline.py)
 # — arm it via the environment (workers re-arm from the parent's spec);
 # kind 'exit' there is a real worker kill.
+# ``controller.spawn`` / ``controller.resize`` fire inside the ELASTIC
+# CONTROLLER process (resilience/controller.py): spawn hits before each
+# incarnation comes up, resize hits in the crash window between draining
+# the old world and spawning the new one — kind 'exit' there is a real
+# control-plane death, which the controller's state file must survive.
 SITES = ("kvstore.allreduce", "dist.barrier", "dataloader.fetch",
-         "checkpoint.save", "trainer.step", "io.decode")
+         "checkpoint.save", "trainer.step", "io.decode",
+         "controller.spawn", "controller.resize")
 
 _M_FAULTS = _tel.counter(
     "mxnet_resilience_faults_injected_total",

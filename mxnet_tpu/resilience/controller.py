@@ -1,0 +1,768 @@
+"""Elastic training controller — jobs that outlive their workers
+(ISSUE 11 tentpole; the role upstream MXNet's ``dmlc_tracker`` played,
+SURVEY L0, rebuilt as a control plane over the TPU stack's own
+resilience primitives).
+
+Every recovery primitive below it already exists — deadline-bounded
+collectives (ISSUE 3), topology-free gather-on-save checkpoints with an
+atomic commit manifest (ISSUES 3/8), per-rank flight-recorder
+postmortems and mergeable telemetry shards (ISSUE 10).  This module is
+the loop that *uses* them: it spawns an n-rank job, watches it, resizes
+it, and survives its own death.
+
+Spawn
+    One process per rank with injected ``MXNET_DIST_*`` env (coordinator
+    address, rank, world size), per-job telemetry / flight-recorder /
+    heartbeat directories, per-incarnation per-rank log files, and
+    ``MXNET_ELASTIC_{INCARNATION,WORLD_TARGET}`` so workers can shard a
+    fixed data space over a changing world.
+
+Watch
+    Exit codes (owned workers), the heartbeat file protocol
+    (``resilience.heartbeat``: staleness beyond ``MXNET_ELASTIC_HANG_S``
+    = hang → SIGKILL), flight-recorder dumps (indexed into every failure
+    event and the terminal roll-up), and the stepclock verdicts embedded
+    in heartbeats: when every peer is comms-bound and exactly one rank
+    is not — and its compute median exceeds the configurable straggler
+    factor — that rank is killed and the world resized around it.
+
+Resize
+    On worker death past bring-up the world shrinks by one (never below
+    ``MXNET_ELASTIC_MIN_WORKERS``) and the whole job restarts from the
+    last *committed* checkpoint step with fresh rank/world env — the
+    topology-free checkpoint is what makes n=4 state restartable at n=3.
+    Once the degraded incarnation commits ``MXNET_ELASTIC_REGROW_STEPS``
+    further steps (read from the checkpoint manifest), the controller
+    drains it (SIGTERM — the workers' preemption save path) and grows
+    back to the target world.  Bring-up failures (heartbeat never
+    reached ``running``) restart at the *same* world size.  Unplanned
+    restarts burn the ``MXNET_ELASTIC_MAX_RESTARTS`` budget and back off
+    with the Retry policy's exponential schedule; planned resizes are
+    free.
+
+Survive
+    Every transition is committed to ``controller.json`` first (atomic
+    write-then-rename, the checkpoint manifest discipline) so a
+    controller killed at ANY point — including mid-resize, which the
+    ``controller.resize`` chaos site exercises deliberately — can be
+    restarted on the same workdir and *re-adopt* the job: live recorded
+    pids are adopted (judged thereafter by heartbeat phase, since an
+    adopted worker has no waitable exit code), a half-finished drain is
+    finished, a half-finished spawn is killed and respawned.
+
+On any terminal outcome the controller writes a postmortem roll-up
+(``<workdir>/report/``): the merged Chrome trace and merged Prometheus
+snapshot over every rank's telemetry shard plus its own, a per-rank
+verdict table, the flight-recorder dump index, and ``summary.json`` with
+the full event history.  Nothing here imports jax — the control plane
+must come up (and report) even when the accelerator stack cannot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import time
+
+from ..base import MXNetError
+from .. import config
+from .. import telemetry as _tel
+from . import chaos as _chaos
+from . import heartbeat as _hb
+from .policies import Retry
+
+__all__ = ["ElasticController", "JobFailedError", "find_straggler"]
+
+STATE_FILE = "controller.json"
+STATE_VERSION = 1
+
+_M_RESTARTS = _tel.counter(
+    "mxnet_controller_restarts_total",
+    "Unplanned whole-job restarts the controller performed (burns the "
+    "MXNET_ELASTIC_MAX_RESTARTS budget).")
+_M_RESIZES = _tel.counter(
+    "mxnet_controller_resizes_total",
+    "World-size changes (shrink on failure, grow-back after probation).")
+_M_FAILURES = _tel.counter(
+    "mxnet_controller_worker_failures_total",
+    "Worker failure events observed (nonzero exits, hangs, stragglers).")
+_M_HANGS = _tel.counter(
+    "mxnet_controller_hangs_total",
+    "Workers SIGKILLed for heartbeat staleness (MXNET_ELASTIC_HANG_S).")
+_M_STRAGGLERS = _tel.counter(
+    "mxnet_controller_stragglers_total",
+    "Workers killed by straggler detection (peers comms-bound, one rank "
+    "compute-bound beyond MXNET_ELASTIC_STRAGGLER_FACTOR).")
+_G_WORLD = _tel.gauge(
+    "mxnet_controller_world_size", "Current live world size.")
+_G_LIVE = _tel.gauge(
+    "mxnet_controller_live_workers", "Workers currently alive.")
+_G_HB_AGE = _tel.gauge(
+    "mxnet_controller_heartbeat_age_seconds",
+    "Oldest live worker's heartbeat age at the last poll — the "
+    "controller-side liveness view of the job.")
+
+
+class JobFailedError(MXNetError):
+    """The job died terminally: restart budget exhausted (or failure
+    with restarts disabled).  The postmortem roll-up is already on disk
+    when this raises."""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(int(pid), 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
+
+
+def _pid_matches(pid, workdir):
+    """Best-effort guard against PID reuse when adopting: every worker
+    is spawned with cwd=workdir, so a recorded pid whose /proc cwd no
+    longer points there is some other process wearing a recycled pid.
+    Unknowable platforms (no /proc) answer True."""
+    try:
+        cwd = os.readlink(f"/proc/{int(pid)}/cwd")
+    except OSError:
+        return True
+    return os.path.realpath(cwd) == os.path.realpath(workdir)
+
+
+def _kill(pid, sig):
+    try:
+        os.kill(int(pid), sig)
+    except OSError:
+        pass
+
+
+def find_straggler(heartbeats, factor):
+    """The straggler rank, or None.
+
+    Fed by the stepclock comms-bound verdict each heartbeat embeds: in a
+    synchronous job a straggler makes every *peer* wait inside the
+    collective (verdict ``comms-bound``) while the straggler itself is
+    the one rank that is not — and its compute median exceeds ``factor``
+    times the fastest peer's.  Requires >= 3 reporting ranks (with two,
+    "everyone else" is one rank — no quorum).  ``factor <= 0`` disables.
+    """
+    if not factor or factor <= 0:
+        return None
+    live = [h for h in heartbeats.values()
+            if h.get("phase") == "running"
+            and (h.get("stepclock") or {}).get("steps")]
+    if len(live) < 3:
+        return None
+    comms = [h for h in live
+             if h["stepclock"].get("verdict") == "comms-bound"]
+    rest = [h for h in live
+            if h["stepclock"].get("verdict") != "comms-bound"]
+    if len(rest) != 1 or len(comms) != len(live) - 1:
+        return None
+    med = (rest[0]["stepclock"].get("phases", {})
+           .get("compute", {}).get("median", 0.0))
+    peer_meds = [h["stepclock"].get("phases", {})
+                 .get("compute", {}).get("median", 0.0) for h in comms]
+    if med > float(factor) * max(min(peer_meds), 1e-9):
+        return int(rest[0]["rank"])
+    return None
+
+
+class _Worker:
+    """One rank of the current incarnation.  ``proc`` is None for an
+    ADOPTED worker (spawned by a previous controller incarnation): no
+    exit code exists for it, so a dead adopted worker is judged by its
+    final heartbeat phase (``done`` = clean, anything else = failure)."""
+
+    __slots__ = ("rank", "pid", "proc", "log", "started", "exit_code",
+                 "killed")
+
+    def __init__(self, rank, pid, proc=None, log=None):
+        self.rank = int(rank)
+        self.pid = int(pid)
+        self.proc = proc
+        self.log = log
+        self.started = time.time()
+        self.exit_code = None
+        self.killed = False
+
+    def alive(self):
+        return self.exit_code is None
+
+
+class ElasticController:
+    """Spawn, watch, resize, survive (module docstring has the story).
+
+    ``command`` is the worker argv (every rank runs it; rank identity
+    arrives via the injected env).  ``workdir`` owns everything: the
+    state file, heartbeat/telemetry/flightrec collection dirs, per-rank
+    logs, the report roll-up — and, by convention, the job's checkpoint
+    tree at ``<workdir>/<ckpt_dir>`` whose ``manifest.json`` the
+    controller reads (jax-free) for resize/regrow decisions.
+    """
+
+    def __init__(self, command, nprocs, workdir, *, min_workers=None,
+                 max_restarts=None, regrow_steps=None, hang_s=None,
+                 straggler_factor=None, grace_s=None, heartbeat_s=None,
+                 env_extra=None, cpu_devices_per_worker=None,
+                 poll_s=0.2, ckpt_dir="ckpt"):
+        if not command:
+            raise MXNetError("elastic controller needs a worker command")
+        self._command = [str(c) for c in command]
+        self._target = int(nprocs)
+        if self._target < 1:
+            raise MXNetError(f"nprocs must be >= 1, got {nprocs}")
+        self._workdir = os.path.abspath(workdir)
+        mw = min_workers if min_workers is not None \
+            else config.get_int("MXNET_ELASTIC_MIN_WORKERS", 1)
+        # clamp into [1, nprocs]: a floor of 0 would let a failure
+        # shrink the world to nothing, which the watch loop would read
+        # as vacuous success
+        self._min_workers = max(1, min(int(mw), self._target))
+        self._max_restarts = max_restarts if max_restarts is not None \
+            else config.get_int("MXNET_ELASTIC_MAX_RESTARTS", 8)
+        self._regrow_steps = regrow_steps if regrow_steps is not None \
+            else config.get_int("MXNET_ELASTIC_REGROW_STEPS", 0)
+        self._hang_s = hang_s if hang_s is not None \
+            else config.get_float("MXNET_ELASTIC_HANG_S", 60.0)
+        self._straggler_factor = straggler_factor \
+            if straggler_factor is not None \
+            else config.get_float("MXNET_ELASTIC_STRAGGLER_FACTOR", 0.0)
+        self._grace_s = grace_s if grace_s is not None \
+            else config.get_float("MXNET_ELASTIC_GRACE_S", 10.0)
+        self._heartbeat_s = heartbeat_s if heartbeat_s is not None \
+            else config.get_float("MXNET_ELASTIC_HEARTBEAT_S", 2.0)
+        self._env_extra = dict(env_extra or {})
+        self._cpu_devices = cpu_devices_per_worker
+        self._poll_s = float(poll_s)
+        self._ckpt_dir = ckpt_dir if os.path.isabs(ckpt_dir) \
+            else os.path.join(self._workdir, ckpt_dir)
+        # escalation schedule: the SAME exponential-backoff policy the
+        # kvstore retries use, applied between whole-job restarts
+        self._backoff = Retry(site="controller.restart")
+
+        self._workers = []
+        self._world = 0
+        self._incarnation = -1          # first spawn makes it 0
+        self._restarts = 0
+        self._regrow_at = None
+        self._coordinator = None
+        self._history = []
+        self._outcome = None
+
+    # -- paths --------------------------------------------------------------
+
+    @property
+    def workdir(self):
+        return self._workdir
+
+    def _state_path(self):
+        return os.path.join(self._workdir, STATE_FILE)
+
+    def _telemetry_dir(self):
+        return os.path.join(self._workdir, "telemetry")
+
+    def _flightrec_dir(self):
+        return os.path.join(self._workdir, "flightrec")
+
+    def _hb_dir(self, incarnation=None):
+        k = self._incarnation if incarnation is None else incarnation
+        return os.path.join(self._workdir, "hb", f"inc{int(k):04d}")
+
+    def _log_path(self, rank):
+        return os.path.join(self._workdir, "logs",
+                            f"inc{self._incarnation:04d}-rank{rank}.log")
+
+    def _report_dir(self):
+        return os.path.join(self._workdir, "report")
+
+    # -- crash-consistent state (write-then-rename, manifest discipline) ----
+
+    def _save_state(self, phase, **extra):
+        st = {
+            "version": STATE_VERSION,
+            "phase": phase,
+            "command": self._command,
+            "target_world": self._target,
+            "world": self._world,
+            "incarnation": self._incarnation,
+            "restarts": self._restarts,
+            "regrow_at": self._regrow_at,
+            "coordinator": self._coordinator,
+            "workers": [{"rank": w.rank, "pid": w.pid, "log": w.log}
+                        for w in self._workers],
+            "history": self._history[-200:],
+        }
+        st.update(extra)
+        os.makedirs(self._workdir, exist_ok=True)
+        path = self._state_path()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(st, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _load_state(self):
+        try:
+            with open(self._state_path()) as f:
+                st = json.load(f)
+        except (FileNotFoundError, ValueError, OSError):
+            return None
+        return st if isinstance(st, dict) and "phase" in st else None
+
+    def _event(self, name, **attrs):
+        ev = {"t": time.time(), "event": name,
+              "incarnation": self._incarnation, "world": self._world}
+        ev.update(attrs)
+        self._history.append(ev)
+        _tel.instant(f"controller.{name}", "controller", **attrs)
+
+    # -- spawn --------------------------------------------------------------
+
+    def _worker_env(self, rank, world):
+        env = dict(os.environ)
+        # per-job observability: every rank exports a mergeable telemetry
+        # shard and leaves flight-recorder postmortems where the roll-up
+        # reads them — FORCED over ambient env (an inherited
+        # MXNET_TELEMETRY_DIR would divert the shards and leave the
+        # merged report empty); an explicit env_extra may still override
+        env["MXNET_TELEMETRY"] = "1"
+        env["MXNET_TELEMETRY_DIR"] = self._telemetry_dir()
+        env["MXNET_FLIGHTREC_DIR"] = self._flightrec_dir()
+        env.update(self._env_extra)
+        env["MXNET_DIST_COORDINATOR"] = self._coordinator
+        env["MXNET_DIST_NUM_WORKERS"] = str(world)
+        env["MXNET_DIST_RANK"] = str(rank)
+        env["MXNET_ELASTIC_INCARNATION"] = str(self._incarnation)
+        env["MXNET_ELASTIC_WORLD_TARGET"] = str(self._target)
+        env["MXNET_ELASTIC_HEARTBEAT_DIR"] = self._hb_dir()
+        env["MXNET_ELASTIC_HEARTBEAT_S"] = str(self._heartbeat_s)
+        if self._cpu_devices:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                f"{env.get('XLA_FLAGS', '')} --xla_force_host_platform_"
+                f"device_count={self._cpu_devices}").strip()
+        return env
+
+    def _spawn_world(self, world):
+        """Bring up one incarnation at ``world`` ranks.  The pid list is
+        committed to the state file as each worker spawns, so a
+        controller death mid-spawn leaves every orphan findable."""
+        if _chaos._ACTIVE:
+            _chaos.hit("controller.spawn")
+        world = int(world)
+        self._incarnation += 1
+        self._world = world
+        self._workers = []
+        self._coordinator = f"127.0.0.1:{_free_port()}"
+        os.makedirs(self._hb_dir(), exist_ok=True)
+        os.makedirs(os.path.join(self._workdir, "logs"), exist_ok=True)
+        self._save_state("spawning")
+        with _tel.span("controller.spawn", "controller", world=world,
+                       incarnation=self._incarnation):
+            for rank in range(world):
+                log = self._log_path(rank)
+                with open(log, "ab") as lf:
+                    proc = subprocess.Popen(
+                        self._command, env=self._worker_env(rank, world),
+                        stdout=lf, stderr=subprocess.STDOUT,
+                        cwd=self._workdir)
+                self._workers.append(_Worker(rank, proc.pid, proc, log))
+                self._save_state("spawning")
+        # degraded worlds run on probation: after REGROW_STEPS further
+        # committed checkpoint steps the controller grows back
+        if world < self._target and self._regrow_steps > 0:
+            latest = self._manifest_latest()
+            self._regrow_at = (latest if latest is not None else -1) \
+                + self._regrow_steps
+        else:
+            self._regrow_at = None
+        self._save_state("running")
+        _G_WORLD.set(world)
+        self._event("spawned", world=world, incarnation=self._incarnation,
+                    coordinator=self._coordinator)
+
+    # -- watch --------------------------------------------------------------
+
+    def _read_heartbeats(self):
+        return _hb.read_all(self._hb_dir())
+
+    def _manifest_latest(self):
+        """Latest COMMITTED checkpoint step, read jax-free straight from
+        the manifest (the atomicity layer makes this safe to poll)."""
+        try:
+            with open(os.path.join(self._ckpt_dir, "manifest.json")) as f:
+                steps = json.load(f).get("committed") or []
+            return max(int(s) for s in steps) if steps else None
+        except (OSError, ValueError):
+            return None
+
+    def _flightrec_dumps(self):
+        d = self._flightrec_dir()
+        try:
+            return sorted(fn for fn in os.listdir(d)
+                          if fn.startswith("flightrec-")
+                          and fn.endswith(".json"))
+        except OSError:
+            return []
+
+    def _poll_workers(self, heartbeats):
+        """Refresh exit codes.  Owned workers report via wait(); adopted
+        workers via pid liveness + their final heartbeat phase — with
+        the pid-reuse guard re-checked, so a recycled pid reads as the
+        worker's death, not as an immortal (and later SIGKILLable)
+        stranger."""
+        for w in self._workers:
+            if not w.alive():
+                continue
+            if w.proc is not None:
+                code = w.proc.poll()
+                if code is not None:
+                    w.exit_code = code
+            elif not (_pid_alive(w.pid)
+                      and _pid_matches(w.pid, self._workdir)):
+                hb = heartbeats.get(w.rank)
+                w.exit_code = 0 if hb and hb.get("phase") == "done" else 1
+
+    def _check_hangs(self, heartbeats, now):
+        """SIGKILL workers whose heartbeat went stale (a wedged rank
+        holds every peer hostage inside the collective).  A worker that
+        never beat is measured from its spawn time — bring-up counts."""
+        if self._hang_s <= 0:
+            return None
+        hung = None
+        oldest = 0.0
+        for w in self._workers:
+            if not w.alive():
+                continue
+            hb = heartbeats.get(w.rank)
+            last = hb.get("time", w.started) if hb else w.started
+            age = now - last
+            oldest = max(oldest, age)
+            if age > self._hang_s and hung is None:
+                hung = w
+        _G_HB_AGE.set(oldest)
+        if hung is None:
+            return None
+        _M_HANGS.inc()
+        self._event("worker_hang", rank=hung.rank, pid=hung.pid,
+                    age_s=round(now - (heartbeats.get(hung.rank) or {})
+                                .get("time", hung.started), 3))
+        _kill(hung.pid, signal.SIGKILL)
+        hung.killed = True
+        hung.exit_code = -9
+        return hung.rank
+
+    def _check_straggler(self, heartbeats):
+        r = find_straggler(heartbeats, self._straggler_factor)
+        if r is None:
+            return None
+        w = next((w for w in self._workers if w.rank == r and w.alive()),
+                 None)
+        if w is None:
+            return None
+        _M_STRAGGLERS.inc()
+        self._event("straggler", rank=r, pid=w.pid)
+        _kill(w.pid, signal.SIGKILL)
+        w.killed = True
+        w.exit_code = -9
+        return r
+
+    def _reached_running(self, heartbeats):
+        return any(h.get("phase") in ("running", "done")
+                   for h in heartbeats.values())
+
+    # -- resize -------------------------------------------------------------
+
+    def _drain(self, reason, next_world=None, phase="draining"):
+        """Stop every live worker: SIGTERM (the preemption-save path the
+        checkpoint SIGTERM hook and flight recorder both handle), a
+        grace period, then SIGKILL.  The drain intent is committed to
+        the state file FIRST so a controller death mid-drain is
+        resumable.  A TERMINAL drain passes phase='failed' — the
+        outcome must be on disk before the reaping starts, or a crash
+        mid-drain would let a rerun resurrect a budget-exhausted job."""
+        self._save_state(phase, reason=reason, next_world=next_world)
+        with _tel.span("controller.drain", "controller", reason=reason):
+            for w in self._workers:
+                if w.alive():
+                    _kill(w.pid, signal.SIGTERM)
+            deadline = time.time() + max(0.0, self._grace_s)
+            while time.time() < deadline:
+                self._poll_workers(self._read_heartbeats())
+                if not any(w.alive() for w in self._workers):
+                    break
+                time.sleep(min(0.1, self._poll_s))
+            for w in self._workers:
+                if w.alive():
+                    _kill(w.pid, signal.SIGKILL)
+                    w.killed = True
+                    w.exit_code = -9
+                    if w.proc is not None:
+                        try:  # reap: a long-lived controller spawns many
+                            w.proc.wait(timeout=5)
+                        except Exception:  # noqa: BLE001
+                            pass
+
+    def _resize(self, next_world, reason, planned):
+        """Drain the current incarnation and bring up the next one at
+        ``next_world``.  The ``controller.resize`` chaos site fires in
+        the crash window this method is designed around: old world down,
+        new world not yet up, state = draining(next_world)."""
+        with _tel.span("controller.resize", "controller",
+                       from_world=self._world, to_world=next_world,
+                       reason=reason, planned=planned):
+            old = self._world
+            self._drain(reason, next_world=next_world)
+            if _chaos._ACTIVE:
+                _chaos.hit("controller.resize")
+            if not planned:
+                delay = self._backoff.backoff_delay(self._restarts - 1)
+                if delay > 0:
+                    time.sleep(delay)
+            self._spawn_world(next_world)
+        if next_world != old:
+            _M_RESIZES.inc()
+            self._event("resized", from_world=old, to_world=next_world,
+                        reason=reason, planned=planned)
+
+    def _on_failure(self, kind, heartbeats, detail=None):
+        """Classify a failure and restart the job.  Raises
+        JobFailedError when the restart budget is spent."""
+        _M_FAILURES.inc()
+        codes = {w.rank: w.exit_code for w in self._workers}
+        bringup = not self._reached_running(heartbeats)
+        dumps = self._flightrec_dumps()
+        self._event("worker_failure", kind=kind, detail=detail,
+                    exit_codes=codes, bringup=bringup,
+                    flightrec=len(dumps))
+        if self._restarts >= self._max_restarts:
+            self._event("budget_exhausted", restarts=self._restarts)
+            # terminal path still owns the survivors: a hang/straggler
+            # kill leaves healthy peers running — reap them before
+            # dying, with the 'failed' outcome committed first
+            self._drain(f"terminal.{kind}", phase="failed")
+            self._finish("failed", f"restart budget exhausted after "
+                                   f"{self._restarts} restarts "
+                                   f"(last failure: {kind})")
+            raise JobFailedError(
+                f"elastic job failed: {kind} with the restart budget "
+                f"({self._max_restarts}) exhausted; postmortem roll-up "
+                f"in {self._report_dir()}")
+        self._restarts += 1
+        _M_RESTARTS.inc()
+        # bring-up failures (rendezvous timeout surfaced through the
+        # heartbeat 'failed' phase) keep the world size: no rank proved
+        # dead mid-training, shrinking would only shed capacity
+        if bringup:
+            next_world = self._world
+        else:
+            next_world = max(self._min_workers, self._world - 1)
+        self._resize(next_world, reason=kind, planned=False)
+
+    # -- re-adoption --------------------------------------------------------
+
+    def _recover(self, st):
+        """Resume a previous controller's job from its state file.
+        Every phase has exactly one recovery action (the state write
+        always PRECEDES the action it describes)."""
+        self._target = int(st.get("target_world", self._target))
+        self._world = int(st.get("world", 0))
+        self._incarnation = int(st.get("incarnation", -1))
+        self._restarts = int(st.get("restarts", 0))
+        self._regrow_at = st.get("regrow_at")
+        self._coordinator = st.get("coordinator")
+        self._history = list(st.get("history") or [])
+        phase = st["phase"]
+        self._event("recover", phase=phase)
+        if phase in ("done", "failed"):
+            self._outcome = phase
+            return
+        recorded = st.get("workers") or []
+        if phase == "running":
+            # adopt live pids; dead ones are classified by the poll loop
+            # from their final heartbeat phase
+            self._workers = []
+            heartbeats = self._read_heartbeats()
+            for rec in recorded:
+                w = _Worker(rec["rank"], rec["pid"], proc=None,
+                            log=rec.get("log"))
+                if not (_pid_alive(w.pid)
+                        and _pid_matches(w.pid, self._workdir)):
+                    hb = heartbeats.get(w.rank)
+                    w.exit_code = 0 if hb and hb.get("phase") == "done" \
+                        else 1
+                self._workers.append(w)
+            self._event("adopted",
+                        live=[w.rank for w in self._workers if w.alive()])
+            self._save_state("running")
+            return
+        # spawning / draining: the old incarnation must not survive into
+        # the new one — kill every recorded pid, then take the one step
+        # the dead controller never reached
+        for rec in recorded:
+            if _pid_alive(rec["pid"]) \
+                    and _pid_matches(rec["pid"], self._workdir):
+                _kill(rec["pid"], signal.SIGKILL)
+        if phase == "draining":
+            nxt = st.get("next_world") or self._world or self._target
+            self._event("resume_resize", to_world=nxt)
+            self._spawn_world(nxt)
+        else:  # spawning: partial world — respawn the incarnation fresh
+            nxt = self._world or self._target
+            self._event("resume_spawn", to_world=nxt)
+            self._spawn_world(nxt)
+
+    # -- run ----------------------------------------------------------------
+
+    def run(self):
+        """Drive the job to a terminal outcome.  Returns the summary
+        dict (also written to ``<workdir>/report/summary.json``); raises
+        JobFailedError when the job dies for good."""
+        os.makedirs(self._workdir, exist_ok=True)
+        if not _tel.enabled():
+            _tel.enable()
+        st = self._load_state()
+        if st is not None:
+            # the state file owns the target: a rerun with a different
+            # -n must not re-target the job (or mis-rank the controller)
+            self._target = int(st.get("target_world", self._target))
+        # the controller is its own observability rank: one PAST the
+        # worker ranks (stable across resizes — the target is fixed)
+        _tel.aggregate.set_rank(self._target)
+        _tel.tracer.get_tracer().set_process_label("mxnet_tpu controller")
+        _tel.flightrec.note("controller.start", workdir=self._workdir,
+                            target=self._target)
+        with _tel.span("controller.job", "controller",
+                       target=self._target):
+            if st is not None:
+                self._recover(st)
+                if self._outcome is not None:
+                    return self._summary(self._outcome)
+            else:
+                self._spawn_world(self._target)
+            return self._watch_loop()
+
+    def _watch_loop(self):
+        while True:
+            heartbeats = self._read_heartbeats()
+            self._poll_workers(heartbeats)
+            live = sum(1 for w in self._workers if w.alive())
+            _G_LIVE.set(live)
+            if live == 0:
+                codes = [w.exit_code for w in self._workers]
+                if all(c == 0 for c in codes):
+                    self._finish("done", "all ranks completed")
+                    return self._summary("done")
+                self._on_failure("worker_death", heartbeats,
+                                 detail={"exit_codes": codes})
+                continue
+            if any(w.exit_code not in (None, 0) for w in self._workers):
+                # a dead rank strands every live peer inside the next
+                # collective — drain now, don't wait for their deadlines
+                self._on_failure("worker_death", heartbeats)
+                continue
+            now = time.time()
+            if self._check_hangs(heartbeats, now) is not None:
+                self._on_failure("hang", heartbeats)
+                continue
+            if self._check_straggler(heartbeats) is not None:
+                self._on_failure("straggler", heartbeats)
+                continue
+            if self._regrow_at is not None:
+                latest = self._manifest_latest()
+                if latest is not None and latest >= self._regrow_at:
+                    self._event("regrow", at_step=latest,
+                                to_world=self._target)
+                    self._resize(self._target, reason="regrow",
+                                 planned=True)
+                    continue
+            time.sleep(self._poll_s)
+
+    # -- terminal roll-up ---------------------------------------------------
+
+    def _finish(self, outcome, detail):
+        self._outcome = outcome
+        self._event(outcome, detail=detail)
+        self._save_state(outcome, detail=detail)
+        _G_LIVE.set(0)
+        self._rollup(outcome, detail)
+
+    def _summary(self, outcome):
+        return {
+            "outcome": outcome,
+            "target_world": self._target,
+            "final_world": self._world,
+            "incarnations": self._incarnation + 1,
+            "restarts": self._restarts,
+            "history": list(self._history),
+            "workdir": self._workdir,
+            "report": self._report_dir(),
+        }
+
+    def _rollup(self, outcome, detail):
+        """The terminal postmortem: merged Chrome trace + merged
+        Prometheus snapshot over every rank's shard (and the
+        controller's own), per-rank verdict table, flight-recorder dump
+        index, full event history.  Best-effort — reporting must never
+        mask the job's real outcome."""
+        try:
+            rd = self._report_dir()
+            os.makedirs(rd, exist_ok=True)
+            teldir = self._telemetry_dir()
+            try:
+                _tel.aggregate.export_snapshot(directory=teldir)
+            except Exception:  # noqa: BLE001
+                pass
+            snaps = _tel.aggregate.load_snapshots(teldir)
+            trace = _tel.aggregate.merged_chrome_trace(snaps)
+            with open(os.path.join(rd, "merged_trace.json"), "w") as f:
+                json.dump(trace, f)
+            with open(os.path.join(rd, "merged.prom"), "w") as f:
+                f.write(_tel.aggregate.merged_prometheus(snaps))
+            dumps = self._flightrec_dumps()
+            summary = self._summary(outcome)
+            summary["detail"] = detail
+            summary["flightrec"] = dumps
+            summary["chaos"] = {"armed_sites": _chaos.sites(),
+                                "faults_fired": {
+                                    s: _chaos.fault_count(s)
+                                    for s in ("controller.spawn",
+                                              "controller.resize")}}
+            with open(os.path.join(rd, "summary.json"), "w") as f:
+                json.dump(summary, f, indent=1)
+            lines = [f"elastic job {outcome}: {detail}",
+                     f"  target world {self._target}, final world "
+                     f"{self._world}, {self._incarnation + 1} "
+                     f"incarnation(s), {self._restarts} unplanned "
+                     f"restart(s)", ""]
+            for s in snaps:
+                sc = s.get("stepclock") or {}
+                lines.append(
+                    f"  rank {s.get('rank')}: verdict "
+                    f"{sc.get('verdict', 'idle')} over "
+                    f"{sc.get('steps', 0)} step(s)")
+            if dumps:
+                lines.append("")
+                lines.append(f"  {len(dumps)} flight-recorder dump(s):")
+                lines.extend(f"    {d}" for d in dumps)
+            with open(os.path.join(rd, "report.txt"), "w") as f:
+                f.write("\n".join(lines) + "\n")
+        except Exception:  # noqa: BLE001 — the roll-up is best-effort
+            pass

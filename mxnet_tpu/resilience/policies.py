@@ -107,6 +107,18 @@ class Retry:
             return True
         return is_transient(exc)
 
+    def backoff_delay(self, attempt):
+        """Jittered delay (seconds) before re-attempt ``attempt`` (0 =
+        first retry) — the policy's schedule exposed for callers that
+        escalate OUTSIDE call() (the elastic controller sleeps this
+        between whole-job restarts).  Negative attempts cost nothing."""
+        if attempt < 0:
+            return 0.0
+        delay = min(self.backoff_s * (2 ** attempt), self.backoff_max_s)
+        if self.jitter:
+            delay *= 1 + self.jitter * (2 * random.random() - 1)
+        return max(0.0, delay)
+
     def call(self, fn, *args, **kwargs):
         attempt = 0
         while True:
@@ -119,10 +131,7 @@ class Retry:
                     raise RetryExhaustedError(
                         f"{self.site or 'call'} failed after "
                         f"{attempt + 1} attempts: {exc}") from exc
-                delay = min(self.backoff_s * (2 ** attempt),
-                            self.backoff_max_s)
-                if self.jitter:
-                    delay *= 1 + self.jitter * (2 * random.random() - 1)
+                delay = self.backoff_delay(attempt)
                 _M_RETRIES.inc()
                 _M_BACKOFF_SECONDS.observe(delay)
                 if delay > 0:

@@ -32,7 +32,7 @@ default):
 Dumps are atomic (write-then-rename, the checkpoint manifest discipline),
 bounded in count (``MXNET_FLIGHTREC_MAX_DUMPS`` per process) and land in
 ``MXNET_FLIGHTREC_DIR`` (default: ``MXNET_TELEMETRY_DIR``, else
-``./flightrec``).  When a telemetry collection dir is configured, a dump
+``~/.cache/mxnet_tpu/flightrec`` — never the working tree).  When a telemetry collection dir is configured, a dump
 also exports this rank's telemetry snapshot — so a crashed rank still
 contributes to the merged trace.  :func:`dump` never raises and nothing
 here imports jax.
@@ -69,7 +69,14 @@ def enabled():
 
 def dump_dir():
     d = config.get("MXNET_FLIGHTREC_DIR") or config.get("MXNET_TELEMETRY_DIR")
-    return d or os.path.join(os.getcwd(), "flightrec")
+    if d:
+        return d
+    # default OUTSIDE the working tree (satellite: bench/example runs
+    # from a source checkout were littering ./flightrec into the repo);
+    # spawned workers inherit MXNET_FLIGHTREC_DIR, so one process-wide
+    # redirect covers a whole job
+    return os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu",
+                        "flightrec")
 
 
 def note(event, **attrs):

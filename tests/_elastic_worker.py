@@ -1,0 +1,139 @@
+"""Worker body for the elastic-controller e2e suite (ISSUE 11
+acceptance; tests/test_elastic_chaos.py).  Not collected by pytest.
+
+This worker demonstrates the documented contract that makes "resize the
+world" bit-exact — **shard-resident gradient accumulation** over a data
+space fixed by ``MXNET_ELASTIC_WORLD_TARGET`` (W), independent of the
+live world size n:
+
+ - every step's global batch is W shards, seeded by (step, shard) only;
+ - live rank r owns shards {s : s mod n == r}; for each shard s IN FIXED
+   ORDER the job runs ONE kvstore allreduce to which exactly one rank
+   contributes that shard's gradient and every other rank contributes
+   zeros — so the summed result is the shard gradient EXACTLY (x + 0 is
+   exact in IEEE arithmetic, in any association the collective picks);
+ - each rank accumulates the W reduced shard gradients in the same fixed
+   order and applies the same SGD update in float32.
+
+Under that contract the parameter trajectory is a pure function of the
+step count: killing ranks, shrinking to n=3, growing back to n=4, and
+replaying from the topology-free checkpoint all reproduce the
+uninterrupted fixed-n run's parameters BIT-identically.  The *resize
+points* (which incarnation executed which steps) are recorded in the
+checkpoint manifest's per-step world audit — that record is the "modulo
+documented resize points" part of the acceptance criterion.
+
+Modes (argv[1]):
+ - ``clean`` — run all steps at the launched world size.
+ - ``die``   — in incarnation 0 ONLY, the highest rank arms a chaos
+   ``exit`` on ``kvstore.allreduce`` at step DIE_STEP: real worker death
+   mid-collective.  Survivors exit via SIGTERM (controller drain) or the
+   Deadline — every rank leaves a flight-recorder postmortem.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        # multi-proc CPU collectives need gloo BEFORE backend init
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
+
+jax.distributed.initialize(
+    coordinator_address=os.environ["MXNET_DIST_COORDINATOR"],
+    num_processes=int(os.environ["MXNET_DIST_NUM_WORKERS"]),
+    process_id=int(os.environ["MXNET_DIST_RANK"]))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu.resilience import chaos, heartbeat  # noqa: E402
+
+TOTAL = 8
+DIE_STEP = 2
+LR = np.float32(0.05)
+
+
+def main():
+    mode, outdir = sys.argv[1], sys.argv[2]
+    rank = int(os.environ["MXNET_DIST_RANK"])
+    n = int(os.environ["MXNET_DIST_NUM_WORKERS"])
+    wt = os.environ.get("MXNET_ELASTIC_WORLD_TARGET")
+    W = int(wt) if wt else n
+    inc = int(os.environ.get("MXNET_ELASTIC_INCARNATION", "0"))
+
+    kv = mx.kv.create("dist_tpu_sync")
+    kv.set_bucket_size(0)
+    _ = kv.rank          # force bring-up: heartbeat + rank tagging start
+
+    mx.random.seed(11)   # identical init on every rank, every incarnation
+    net = gluon.nn.Dense(3, in_units=5, prefix="net_")
+    net.initialize(mx.initializer.Xavier())
+    params = net.collect_params()
+    lossf = gluon.loss.L2Loss()
+    shapes = [(name, tuple(p.shape)) for name, p in params.items()]
+    flat_n = sum(int(np.prod(s)) for _, s in shapes)
+    kv.init("flat", mx.nd.zeros((flat_n,)))
+
+    # topology-free checkpoints; keep every step so the manifest's
+    # world audit preserves the full resize record for the test
+    mgr = mx.checkpoint.CheckpointManager(os.path.join(outdir, "ckpt"),
+                                          max_to_keep=2 * TOTAL)
+    last, _ = mgr.restore(net=net)
+    start = last + 1 if last is not None else 0
+
+    def shard_batch(step, s):
+        r = np.random.RandomState(9000 + 17 * step + s)  # (step, shard) only
+        return (mx.nd.array(r.randn(4, 5).astype(np.float32)),
+                mx.nd.array(r.randn(4, 3).astype(np.float32)))
+
+    zeros = np.zeros((flat_n,), np.float32)
+    out = mx.nd.zeros((flat_n,))
+    for step in range(start, TOTAL):
+        heartbeat.set_step(step)
+        if mode == "die" and inc == 0 and rank == n - 1 \
+                and step == DIE_STEP:
+            # the NEXT allreduce is this step's shard-0 reduction:
+            # death strictly mid-collective
+            chaos.inject("kvstore.allreduce", kind="exit", times=1)
+        tot = zeros.copy()
+        for s in range(W):                 # fixed shard order, any n
+            if s % n == rank:
+                x, y = shard_batch(step, s)
+                with autograd.record():
+                    loss = lossf(net(x), y)
+                loss.backward()
+                g = np.concatenate(
+                    [p.grad().asnumpy().ravel() for _, p in
+                     params.items()]).astype(np.float32, copy=False)
+            else:
+                g = zeros
+            kv.push("flat", mx.nd.array(g))
+            kv.pull("flat", out=out)
+            tot = tot + out.asnumpy()      # fixed association order
+        off = 0
+        for name, shape in shapes:
+            size = int(np.prod(shape))
+            gpart = tot[off:off + size].reshape(shape)
+            off += size
+            p = params[name]
+            p.set_data(mx.nd.array(p.data().asnumpy() - LR * gpart))
+        mgr.save(step, net=net)
+
+    np.savez(os.path.join(outdir, f"final_rank{rank}.npz"),
+             **{k: p.data().asnumpy() for k, p in params.items()})
+    heartbeat.mark_done()
+    print(f"worker {rank}/{n} inc{inc} [{mode}]: OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,136 @@
+"""Stdlib-only stub worker for the FAST elastic-controller tests
+(tests/test_elastic.py).  Speaks the documented heartbeat file protocol
+and checkpoint-manifest format directly — no jax, no mxnet_tpu import —
+so controller spawn/watch/resize/adopt paths run in milliseconds.  Not
+collected by pytest (no test_ prefix).
+
+Modes (argv[1]); behavior keys off MXNET_ELASTIC_INCARNATION so one
+command covers a whole resize story:
+
+ - ``ok``            — beat running, beat done, exit 0.
+ - ``forever``       — beat until killed, or until a ``finish-flag``
+   file appears in the cwd (the controller runs workers with
+   cwd=workdir), then beat done and exit 0.
+ - ``bringup-fail``  — beat phase=failed (the bring-up-timeout surface)
+   and exit 1; the controller must restart at the SAME world size.
+ - ``resize``        — incarnation 0: the highest rank exits 3 (worker
+   death), peers run forever; incarnation 1 (degraded): rank 0 commits
+   checkpoint-manifest steps so the controller's regrow probation can
+   elapse; incarnation 2+ (regrown): clean completion.
+ - ``hang``          — incarnation 0: the highest rank goes silent
+   (alive, no beats) — the controller must SIGKILL it on staleness;
+   later incarnations complete.
+ - ``straggler``     — incarnation 0: every rank beats a crafted
+   stepclock summary (rank 1 compute-bound and slow, peers comms-bound)
+   and runs forever; the controller must kill rank 1 and resize; later
+   incarnations complete.
+"""
+
+import json
+import os
+import sys
+import time
+
+RANK = int(os.environ.get("MXNET_DIST_RANK", "0"))
+N = int(os.environ.get("MXNET_DIST_NUM_WORKERS", "1"))
+INC = int(os.environ.get("MXNET_ELASTIC_INCARNATION", "0"))
+HB = os.environ.get("MXNET_ELASTIC_HEARTBEAT_DIR")
+BEAT_S = float(os.environ.get("MXNET_ELASTIC_HEARTBEAT_S", "0.1"))
+
+
+def beat(phase="running", step=None, stepclock=None, error=None):
+    if not HB:
+        return
+    os.makedirs(HB, exist_ok=True)
+    rec = {"rank": RANK, "pid": os.getpid(), "time": time.time(),
+           "phase": phase, "step": step, "incarnation": INC, "world": N,
+           "stepclock": stepclock or {"steps": 0, "verdict": "idle"},
+           "error": error}
+    path = os.path.join(HB, f"hb-rank{RANK:05d}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, path)
+
+
+def write_manifest(steps):
+    os.makedirs("ckpt", exist_ok=True)
+    tmp = os.path.join("ckpt", f"manifest.json.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump({"committed": steps}, f)
+    os.replace(tmp, os.path.join("ckpt", "manifest.json"))
+
+
+def run_forever(one_beat):
+    while True:
+        one_beat()
+        if os.path.exists("finish-flag"):
+            beat("done")
+            return 0
+        time.sleep(BEAT_S)
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "ok"
+    if mode == "ok":
+        beat("running", step=0)
+        time.sleep(BEAT_S)
+        beat("done")
+        return 0
+    if mode == "forever":
+        return run_forever(lambda: beat("running"))
+    if mode == "bringup-fail":
+        if INC == 0:
+            beat("failed", error="bringup-timeout: stub rendezvous")
+            return 1
+        beat("running")
+        beat("done")
+        return 0
+    if mode == "resize":
+        if INC == 0:
+            beat("running", step=0)
+            if RANK == N - 1:
+                time.sleep(2 * BEAT_S)
+                return 3                       # worker death mid-job
+            return run_forever(lambda: beat("running"))
+        if INC == 1:                           # degraded probation
+            k = 0
+            while True:
+                if RANK == 0:
+                    write_manifest(list(range(k + 1)))
+                    k += 1
+                beat("running", step=k)
+                if os.path.exists("finish-flag"):
+                    beat("done")
+                    return 0
+                time.sleep(BEAT_S)
+        beat("running")                        # regrown world
+        time.sleep(BEAT_S)
+        beat("done")
+        return 0
+    if mode == "hang":
+        if INC == 0:
+            if RANK == N - 1:
+                beat("running")
+                time.sleep(3600)               # alive but silent
+                return 0
+            return run_forever(lambda: beat("running"))
+        beat("running")
+        beat("done")
+        return 0
+    if mode == "straggler":
+        if INC == 0:
+            slow = RANK == 1
+            sc = {"steps": 8,
+                  "verdict": "compute-bound" if slow else "comms-bound",
+                  "phases": {"compute": {"median": 0.5 if slow else 0.01}}}
+            return run_forever(
+                lambda: beat("running", step=8, stepclock=sc))
+        beat("running")
+        beat("done")
+        return 0
+    raise SystemExit(f"unknown stub mode {mode!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -150,6 +150,57 @@ def test_checkpoint_manager_roundtrip(tmp_path):
     assert float(extra["epoch"].asnumpy()[0]) == 3.0
 
 
+def test_manifest_world_audit_on_resized_restore(tmp_path):
+    """Resume-with-different-n audit (ISSUE 11): the manifest records
+    the world that committed each step; restoring into a different world
+    warns (a documented resize point), counts, and still restores the
+    topology-free params."""
+    import json
+    import os
+    import warnings
+    from mxnet_tpu.telemetry import REGISTRY
+
+    net, _tr = _make_net_trainer()
+    mgr = mx.checkpoint.CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(0, net=net)
+    # single-process save records world n=1, unsharded
+    assert mgr.world_size(0) == 1
+    man_path = os.path.join(str(tmp_path / "ck"), "manifest.json")
+    with open(man_path) as f:
+        man = json.load(f)
+    assert man["world"]["0"] == {"n": 1, "sharded": False}
+    # same-world restore: silent, uncounted
+    before = REGISTRY.get("mxnet_checkpoint_resize_restores_total").value
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        mgr.restore(net=net)
+    assert REGISTRY.get(
+        "mxnet_checkpoint_resize_restores_total").value == before
+    # pretend a 4-rank world committed step 0 → elastic resize point
+    man["world"]["0"] = {"n": 4, "sharded": False}
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+    with pytest.warns(UserWarning, match="elastic resize point"):
+        step, _ = mgr.restore(net=net)
+    assert step == 0
+    assert REGISTRY.get(
+        "mxnet_checkpoint_resize_restores_total").value == before + 1
+    # a SHARDED save restoring elsewhere gets the louder warning
+    man["world"]["0"] = {"n": 4, "sharded": True}
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+    with pytest.warns(UserWarning, match="topology-bound"):
+        mgr.restore(net=net)
+    # pre-audit manifests (no world map) stay silent
+    del man["world"]
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        mgr.restore(net=net)
+    assert mgr.world_size(0) is None
+
+
 def test_kill_and_resume_reproduces_loss_curve(tmp_path):
     # VERDICT acceptance: kill mid-training and resume; the resumed curve
     # must equal the unkilled one (params + adam state + step counts)

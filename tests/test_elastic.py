@@ -1,0 +1,348 @@
+"""Elastic controller fast suite (ISSUE 11): spawn/watch/resize/survive
+against stdlib STUB workers that speak the heartbeat + manifest file
+protocols directly — every control-plane path (worker death → shrink →
+regrow, bring-up failure, hang, straggler, chaos sites, controller death
+mid-resize, re-adoption) runs in seconds with no jax bring-up.  The real
+n=4 jax end-to-end (bit-identity across resize points) lives in
+tests/test_elastic_chaos.py (slow).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401 — conftest platform setup
+from mxnet_tpu.resilience import (
+    ElasticController, JobFailedError, chaos, controller as ctl_mod,
+    heartbeat as hb,
+)
+from mxnet_tpu.resilience.policies import Retry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STUB = os.path.join(REPO, "tests", "_stub_elastic_worker.py")
+LAUNCH = os.path.join(REPO, "tools", "elastic_launch.py")
+
+
+@pytest.fixture(autouse=True)
+def _restore_observability():
+    """Controller runs enable telemetry and re-tag the process rank;
+    undo both (and any armed chaos) so the rest of the suite is
+    unaffected."""
+    import mxnet_tpu.telemetry as tel
+    was_enabled = tel.enabled()
+    yield
+    chaos.clear()
+    tel.aggregate.set_rank(None)
+    tel.tracer.get_tracer().set_process_label("mxnet_tpu")
+    if not was_enabled and not tel.env_enabled():
+        tel.disable()
+
+
+def _ctl(mode, workdir, n, **kw):
+    kw.setdefault("poll_s", 0.03)
+    kw.setdefault("grace_s", 2.0)
+    kw.setdefault("heartbeat_s", 0.1)
+    kw.setdefault("max_restarts", 4)
+    return ElasticController([sys.executable, STUB, mode], n, str(workdir),
+                             **kw)
+
+
+def _events(summary, kind):
+    return [e for e in summary["history"] if e["event"] == kind]
+
+
+# -- protocol units ---------------------------------------------------------
+
+def test_heartbeat_protocol_roundtrip(tmp_path, monkeypatch):
+    d = str(tmp_path / "hb")
+    monkeypatch.setenv("MXNET_ELASTIC_HEARTBEAT_DIR", d)
+    monkeypatch.setenv("MXNET_DIST_RANK", "2")
+    assert hb.enabled()
+    try:
+        assert hb.start(interval_s=0.05)
+        hb.set_step(7)
+        hb.set_phase("running")
+        recs = hb.read_all(d)
+        assert recs[2]["phase"] == "running"
+        assert recs[2]["step"] == 7
+        assert recs[2]["pid"] == os.getpid()
+        assert "verdict" in recs[2]["stepclock"]
+        hb.mark_failed("bringup-timeout: test")
+        recs = hb.read_all(d)
+        assert recs[2]["phase"] == "failed"
+        assert "bringup-timeout" in recs[2]["error"]
+        hb.mark_done()
+        assert hb.read_all(d)[2]["phase"] == "done"
+    finally:
+        hb.stop()
+    # torn/corrupt files are skipped, good ones survive
+    with open(os.path.join(d, "hb-rank00003.json"), "w") as f:
+        f.write("{not json")
+    recs = hb.read_all(d)
+    assert 2 in recs and 3 not in recs
+
+
+def test_heartbeat_inert_without_dir(monkeypatch):
+    monkeypatch.delenv("MXNET_ELASTIC_HEARTBEAT_DIR", raising=False)
+    assert not hb.enabled()
+    assert hb.start() is False
+    assert hb.beat() is None
+
+
+def test_find_straggler_rules():
+    def rank(r, verdict, med, steps=5, phase="running"):
+        return {"rank": r, "phase": phase,
+                "stepclock": {"steps": steps, "verdict": verdict,
+                              "phases": {"compute": {"median": med}}}}
+
+    hbs = {0: rank(0, "comms-bound", 0.01),
+           1: rank(1, "comms-bound", 0.012),
+           2: rank(2, "compute-bound", 0.09)}
+    assert ctl_mod.find_straggler(hbs, 3.0) == 2
+    assert ctl_mod.find_straggler(hbs, 20.0) is None   # not slow enough
+    assert ctl_mod.find_straggler(hbs, 0) is None      # disabled
+    # two ranks = no quorum; two non-comms ranks = no unique straggler
+    assert ctl_mod.find_straggler(
+        {k: hbs[k] for k in (0, 2)}, 3.0) is None
+    hbs4 = dict(hbs)
+    hbs4[3] = rank(3, "compute-bound", 0.09)
+    assert ctl_mod.find_straggler(hbs4, 3.0) is None
+    # idle/bringup ranks don't count toward the quorum
+    hbs[1] = rank(1, "comms-bound", 0.012, steps=0)
+    assert ctl_mod.find_straggler(hbs, 3.0) is None
+
+
+def test_retry_backoff_delay_schedule():
+    r = Retry(backoff_s=0.1, backoff_max_s=0.8, jitter=0)
+    assert [r.backoff_delay(k) for k in (-1, 0, 1, 2, 3, 9)] == \
+        [0.0, 0.1, 0.2, 0.4, 0.8, 0.8]
+
+
+def test_state_file_roundtrip_and_corruption(tmp_path):
+    c = _ctl("ok", tmp_path, 2)
+    c._incarnation = 1
+    c._world = 2
+    c._save_state("running", extra_key="x")
+    st = c._load_state()
+    assert st["phase"] == "running" and st["incarnation"] == 1
+    assert st["extra_key"] == "x"
+    with open(c._state_path(), "w") as f:
+        f.write("{torn")
+    assert c._load_state() is None
+
+
+def test_manifest_latest_reads_commit_ledger(tmp_path):
+    c = _ctl("ok", tmp_path, 2)
+    assert c._manifest_latest() is None
+    os.makedirs(tmp_path / "ckpt")
+    with open(tmp_path / "ckpt" / "manifest.json", "w") as f:
+        json.dump({"committed": [0, 1, 4]}, f)
+    assert c._manifest_latest() == 4
+
+
+# -- whole-job control-plane stories (stub workers) -------------------------
+
+def test_clean_job_completes_with_report(tmp_path):
+    c = _ctl("ok", tmp_path, 3)
+    summary = c.run()
+    assert summary["outcome"] == "done"
+    assert summary["final_world"] == 3
+    assert summary["restarts"] == 0
+    assert summary["incarnations"] == 1
+    st = c._load_state()
+    assert st["phase"] == "done"
+    # terminal roll-up: summary + merged trace + prom + report text
+    rd = tmp_path / "report"
+    with open(rd / "summary.json") as f:
+        assert json.load(f)["outcome"] == "done"
+    with open(rd / "merged_trace.json") as f:
+        trace = json.load(f)
+    # the controller's own job-lifecycle spans ride the merged trace,
+    # under a process lane labeled as the controller
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert "controller.spawn" in names
+    assert any(e.get("ph") == "M" and e.get("name") == "process_name"
+               and "controller" in e["args"]["name"]
+               for e in trace["traceEvents"])
+    assert (rd / "merged.prom").exists()
+    assert (rd / "report.txt").exists()
+
+
+def test_worker_death_resizes_down_then_regrows(tmp_path):
+    c = _ctl("resize", tmp_path, 4, min_workers=2, regrow_steps=3)
+    summary = c.run()
+    assert summary["outcome"] == "done"
+    assert summary["restarts"] == 1
+    assert summary["final_world"] == 4          # grew back
+    assert summary["incarnations"] == 3
+    fails = _events(summary, "worker_failure")
+    assert fails and fails[0]["kind"] == "worker_death"
+    assert fails[0]["bringup"] is False
+    resizes = _events(summary, "resized")
+    assert [(e["from_world"], e["to_world"]) for e in resizes] == \
+        [(4, 3), (3, 4)]
+    assert resizes[0]["planned"] is False and resizes[1]["planned"] is True
+    assert _events(summary, "regrow")
+
+
+def test_bringup_failure_restarts_at_same_world(tmp_path):
+    c = _ctl("bringup-fail", tmp_path, 3)
+    summary = c.run()
+    assert summary["outcome"] == "done"
+    assert summary["restarts"] == 1
+    assert summary["final_world"] == 3          # never shrank
+    fails = _events(summary, "worker_failure")
+    assert fails and fails[0]["bringup"] is True
+    assert not _events(summary, "resized")
+
+
+def test_hang_detection_kills_and_resizes(tmp_path):
+    c = _ctl("hang", tmp_path, 3, hang_s=0.6, min_workers=2)
+    summary = c.run()
+    assert summary["outcome"] == "done"
+    assert summary["final_world"] == 2
+    hangs = _events(summary, "worker_hang")
+    assert hangs and hangs[0]["rank"] == 2
+    assert _events(summary, "worker_failure")[0]["kind"] == "hang"
+
+
+def test_straggler_mitigation_from_stepclock_verdicts(tmp_path):
+    c = _ctl("straggler", tmp_path, 4, straggler_factor=3.0, min_workers=2)
+    summary = c.run()
+    assert summary["outcome"] == "done"
+    assert summary["final_world"] == 3
+    stragglers = _events(summary, "straggler")
+    assert stragglers and stragglers[0]["rank"] == 1
+    assert _events(summary, "worker_failure")[0]["kind"] == "straggler"
+
+
+def test_restart_budget_exhaustion_is_terminal(tmp_path):
+    c = _ctl("bringup-fail", tmp_path, 2, max_restarts=0)
+    with pytest.raises(JobFailedError):
+        c.run()
+    st = c._load_state()
+    assert st["phase"] == "failed"
+    with open(tmp_path / "report" / "summary.json") as f:
+        assert json.load(f)["outcome"] == "failed"
+
+
+def test_controller_chaos_sites_fire_deterministically(tmp_path):
+    """ISSUE 11 satellite: controller.spawn / controller.resize chaos
+    sites with exact hit counts — 3 spawns (initial, shrink, regrow) and
+    2 resizes for the canonical death→shrink→regrow story."""
+    spawn0 = chaos.fault_count("controller.spawn")
+    resize0 = chaos.fault_count("controller.resize")
+    chaos.inject("controller.spawn", kind="delay", times=0, delay_s=0)
+    chaos.inject("controller.resize", kind="delay", times=0, delay_s=0)
+    try:
+        c = _ctl("resize", tmp_path, 4, min_workers=2, regrow_steps=3)
+        summary = c.run()
+    finally:
+        chaos.clear()
+    assert summary["outcome"] == "done"
+    assert chaos.fault_count("controller.spawn") - spawn0 == 3
+    assert chaos.fault_count("controller.resize") - resize0 == 2
+
+
+# -- the controller's own death (subprocess CLI) ----------------------------
+
+def _cli_env(extra=None):
+    env = dict(os.environ)
+    # the controller must own the job's observability dirs (the test
+    # asserts dump locations); drop any suite-level redirects
+    for k in ("MXNET_TELEMETRY_DIR", "MXNET_FLIGHTREC_DIR",
+              "MXNET_CHAOS", "MXNET_CHAOS_SITES"):
+        env.pop(k, None)
+    env.update(extra or {})
+    return env
+
+
+def _cli(workdir, n, mode, extra_env=None, extra_args=()):
+    return subprocess.Popen(
+        [sys.executable, LAUNCH, "-n", str(n), "--workdir", str(workdir),
+         "--grace-s", "2", "--max-restarts", "4", *extra_args,
+         "--", sys.executable, STUB, mode],
+        env=_cli_env(extra_env), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+
+
+@pytest.mark.slow  # two CLI controller launches (~13s)
+def test_controller_death_mid_resize_then_recovery(tmp_path):
+    """Kill the CONTROL PLANE in the resize crash window (old world
+    drained, new world not spawned) via the controller.resize chaos
+    site, then restart it: recovery must finish the resize from the
+    state file and drive the job to completion."""
+    wd = str(tmp_path / "job")
+    p = _cli(wd, 3, "resize",
+             extra_env={"MXNET_CHAOS": "1",
+                        "MXNET_CHAOS_SITES": "controller.resize:exit:1",
+                        "MXNET_ELASTIC_REGROW_STEPS": "3",
+                        "MXNET_ELASTIC_MIN_WORKERS": "2"})
+    out, _ = p.communicate(timeout=60)
+    assert p.returncode != 0, out.decode()
+    with open(os.path.join(wd, "controller.json")) as f:
+        st = json.load(f)
+    assert st["phase"] == "draining"            # died mid-resize
+    assert st["next_world"] == 2
+    # the control plane left its own postmortem
+    dumps = os.listdir(os.path.join(wd, "flightrec"))
+    assert any("chaos.exit.controller.resize" in d for d in dumps), dumps
+
+    p = _cli(wd, 3, "resize",
+             extra_env={"MXNET_ELASTIC_REGROW_STEPS": "3",
+                        "MXNET_ELASTIC_MIN_WORKERS": "2"})
+    out, _ = p.communicate(timeout=60)
+    assert p.returncode == 0, out.decode()
+    with open(os.path.join(wd, "report", "summary.json")) as f:
+        summary = json.load(f)
+    assert summary["outcome"] == "done"
+    assert summary["final_world"] == 3          # regrew to target
+    kinds = [e["event"] for e in summary["history"]]
+    assert "recover" in kinds and "resume_resize" in kinds
+    # chaos bookkeeping surfaced in the roll-up (hit-count assertion for
+    # the first, killed, controller lives in its state-file history)
+    assert "chaos" in summary
+
+
+@pytest.mark.slow  # two CLI controller launches (~5s)
+def test_controller_readoption_of_live_workers(tmp_path):
+    """SIGKILL a controller whose workers are healthy; a fresh
+    controller on the same workdir must ADOPT the live pids (no respawn)
+    and see the job through."""
+    wd = str(tmp_path / "job")
+    p1 = _cli(wd, 2, "forever")
+    state_path = os.path.join(wd, "controller.json")
+    deadline = time.time() + 30
+    st = None
+    while time.time() < deadline:
+        try:
+            with open(state_path) as f:
+                st = json.load(f)
+            if st["phase"] == "running" and len(st["workers"]) == 2:
+                break
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    assert st and st["phase"] == "running"
+    pids = [w["pid"] for w in st["workers"]]
+    os.kill(p1.pid, signal.SIGKILL)
+    p1.wait(timeout=10)
+    assert all(ctl_mod._pid_alive(pid) for pid in pids)  # orphans live on
+
+    p2 = _cli(wd, 2, "forever")
+    time.sleep(0.5)
+    with open(os.path.join(wd, "finish-flag"), "w") as f:
+        f.write("done")
+    out, _ = p2.communicate(timeout=60)
+    assert p2.returncode == 0, out.decode()
+    with open(os.path.join(wd, "report", "summary.json")) as f:
+        summary = json.load(f)
+    assert summary["outcome"] == "done"
+    adopted = [e for e in summary["history"] if e["event"] == "adopted"]
+    assert adopted and sorted(adopted[0]["live"]) == [0, 1]
+    assert summary["incarnations"] == 1         # no respawn happened

@@ -335,6 +335,196 @@ def test_np_zero_d_arrays():
     onp.testing.assert_allclose(got.asnumpy(), [3.5, 7.0])
 
 
+# ---------------------------------------------------------------------------
+# delegated-surface parity extension round 2 (ISSUE 11 satellite): another
+# ~34-function slice — searching/counting, nan-aware statistics, logic
+# predicates, integer/bit math, construction, and axis manipulation —
+# the families where thin jnp delegation could silently diverge from
+# numpy (bool/int result dtypes, nan propagation, negative-axis moves).
+# ---------------------------------------------------------------------------
+
+def _xnan():
+    x = _r((3, 4), 31)
+    x[0, 1] = onp.nan
+    x[2, 2] = onp.inf
+    return x
+
+
+EXT_FNS = [
+    ("searchsorted",
+     lambda m, x: m.searchsorted(m.sort(m.array(x.ravel())),
+                                 m.array(x[0])),
+     lambda x: onp.searchsorted(onp.sort(x.ravel()), x[0])),
+    ("count_nonzero",
+     lambda m, x: m.count_nonzero(m.array(x) > 0, axis=1),
+     lambda x: onp.count_nonzero(x > 0, axis=1)),
+    ("nonzero",
+     lambda m, x: m.nonzero(m.array(x) > 0)[0],
+     lambda x: onp.nonzero(x > 0)[0]),
+    ("flatnonzero",
+     lambda m, x: m.flatnonzero(m.array(x) > 0),
+     lambda x: onp.flatnonzero(x > 0)),
+    ("argwhere",
+     lambda m, x: m.argwhere(m.array(x) > 0),
+     lambda x: onp.argwhere(x > 0)),
+    ("median", lambda m, x: m.median(m.array(x), axis=1),
+     lambda x: onp.median(x, axis=1)),
+    ("percentile", lambda m, x: m.percentile(m.array(x), 30, axis=0),
+     lambda x: onp.percentile(x, 30, axis=0)),
+    ("quantile", lambda m, x: m.quantile(m.array(x), 0.7),
+     lambda x: onp.quantile(x, 0.7)),
+    ("average",
+     lambda m, x: m.average(m.array(x), axis=1),
+     lambda x: onp.average(x, axis=1)),
+    ("ptp", lambda m, x: m.ptp(m.array(x), axis=0),
+     lambda x: onp.ptp(x, axis=0)),
+    ("nanmean", lambda m, x: m.nanmean(m.array(_xnan()), axis=0),
+     lambda x: onp.nanmean(_xnan(), axis=0)),
+    ("nansum", lambda m, x: m.nansum(m.array(_xnan()), axis=1),
+     lambda x: onp.nansum(_xnan(), axis=1)),
+    ("nanmax", lambda m, x: m.nanmax(m.array(_xnan()[:2]), axis=1),
+     lambda x: onp.nanmax(_xnan()[:2], axis=1)),
+    ("nanstd", lambda m, x: m.nanstd(m.array(_xnan()[:2]), axis=1),
+     lambda x: onp.nanstd(_xnan()[:2], axis=1)),
+    ("isnan", lambda m, x: m.isnan(m.array(_xnan())),
+     lambda x: onp.isnan(_xnan())),
+    ("isinf", lambda m, x: m.isinf(m.array(_xnan())),
+     lambda x: onp.isinf(_xnan())),
+    ("isfinite", lambda m, x: m.isfinite(m.array(_xnan())),
+     lambda x: onp.isfinite(_xnan())),
+    ("signbit", lambda m, x: m.signbit(m.array(x)),
+     lambda x: onp.signbit(x)),
+    ("logical_and",
+     lambda m, x: m.logical_and(m.array(x) > 0, m.array(x) < 1),
+     lambda x: onp.logical_and(x > 0, x < 1)),
+    ("logical_or",
+     lambda m, x: m.logical_or(m.array(x) > 1, m.array(x) < -1),
+     lambda x: onp.logical_or(x > 1, x < -1)),
+    ("logical_xor",
+     lambda m, x: m.logical_xor(m.array(x) > 0, m.array(x) > 1),
+     lambda x: onp.logical_xor(x > 0, x > 1)),
+    ("logical_not", lambda m, x: m.logical_not(m.array(x) > 0),
+     lambda x: onp.logical_not(x > 0)),
+    ("isclose",
+     lambda m, x: m.isclose(m.array(x), m.array(x + 1e-7)),
+     lambda x: onp.isclose(x, x + 1e-7)),
+    ("fmax", lambda m, x: m.fmax(m.array(x), m.array(-x)),
+     lambda x: onp.fmax(x, -x)),
+    ("fmin", lambda m, x: m.fmin(m.array(x), m.array(-x)),
+     lambda x: onp.fmin(x, -x)),
+    ("fabs", lambda m, x: m.fabs(m.array(x)), lambda x: onp.fabs(x)),
+    ("heaviside", lambda m, x: m.heaviside(m.array(x), 0.5),
+     lambda x: onp.heaviside(x, onp.float32(0.5))),
+    ("nan_to_num", lambda m, x: m.nan_to_num(m.array(_xnan())),
+     lambda x: onp.nan_to_num(_xnan())),
+    ("ldexp",
+     lambda m, x: m.ldexp(m.array(x),
+                          m.array(onp.arange(5, dtype=onp.int32))),
+     lambda x: onp.ldexp(x, onp.arange(5, dtype=onp.int32))),
+    ("gcd",
+     lambda m, x: m.gcd(m.array(onp.array([12, 18, 7], onp.int32)),
+                        m.array(onp.array([8, 27, 21], onp.int32))),
+     lambda x: onp.gcd(onp.array([12, 18, 7], onp.int32),
+                       onp.array([8, 27, 21], onp.int32))),
+    ("lcm",
+     lambda m, x: m.lcm(m.array(onp.array([4, 6, 5], onp.int32)),
+                        m.array(onp.array([6, 8, 7], onp.int32))),
+     lambda x: onp.lcm(onp.array([4, 6, 5], onp.int32),
+                       onp.array([6, 8, 7], onp.int32))),
+    ("linspace", lambda m, x: m.linspace(-2.0, 2.0, 9),
+     lambda x: onp.linspace(-2.0, 2.0, 9).astype(onp.float32)),
+    ("logspace", lambda m, x: m.logspace(0.0, 2.0, 5),
+     lambda x: onp.logspace(0.0, 2.0, 5).astype(onp.float32)),
+    ("eye", lambda m, x: m.eye(4, 5, 1), lambda x: onp.eye(4, 5, 1)),
+    ("tri", lambda m, x: m.tri(4, 4, -1), lambda x: onp.tri(4, 4, -1)),
+    ("diag", lambda m, x: m.diag(m.diag(m.array(x[:3, :3]))),
+     lambda x: onp.diag(onp.diag(x[:3, :3]))),
+    ("rot90", lambda m, x: m.rot90(m.array(x)),
+     lambda x: onp.rot90(x)),
+    ("fliplr", lambda m, x: m.fliplr(m.array(x)),
+     lambda x: onp.fliplr(x)),
+    ("flipud", lambda m, x: m.flipud(m.array(x)),
+     lambda x: onp.flipud(x)),
+    ("moveaxis",
+     lambda m, x: m.moveaxis(m.array(x[:, :3].reshape(2, 2, 3)), 0, -1),
+     lambda x: onp.moveaxis(x[:, :3].reshape(2, 2, 3), 0, -1)),
+    ("swapaxes", lambda m, x: m.swapaxes(m.array(x), 0, 1),
+     lambda x: onp.swapaxes(x, 0, 1)),
+    ("broadcast_to",
+     lambda m, x: m.broadcast_to(m.array(x[0]), (3, 5)),
+     lambda x: onp.broadcast_to(x[0], (3, 5))),
+    ("bincount",
+     lambda m, x: m.bincount(m.array(onp.array([0, 1, 1, 3, 2, 1],
+                                               onp.int32))),
+     lambda x: onp.bincount(onp.array([0, 1, 1, 3, 2, 1], onp.int32))),
+    ("digitize",
+     lambda m, x: m.digitize(m.array(x),
+                             m.array(onp.array([-1.0, 0.0, 1.0],
+                                               onp.float32))),
+     lambda x: onp.digitize(x, onp.array([-1.0, 0.0, 1.0], onp.float32))),
+    ("interp",
+     lambda m, x: m.interp(m.array(x.ravel()),
+                           m.array(onp.array([-2.0, 0.0, 2.0],
+                                             onp.float32)),
+                           m.array(onp.array([0.0, 1.0, 4.0],
+                                             onp.float32))),
+     lambda x: onp.interp(x.ravel(),
+                          onp.array([-2.0, 0.0, 2.0], onp.float32),
+                          onp.array([0.0, 1.0, 4.0], onp.float32))),
+    ("cross",
+     lambda m, x: m.cross(m.array(x[:, :3]), m.array(x[:, 1:4])),
+     lambda x: onp.cross(x[:, :3], x[:, 1:4])),
+    ("corrcoef", lambda m, x: m.corrcoef(m.array(x)),
+     lambda x: onp.corrcoef(x)),
+    ("cov", lambda m, x: m.cov(m.array(x)), lambda x: onp.cov(x)),
+    ("ediff1d", lambda m, x: m.ediff1d(m.array(x)),
+     lambda x: onp.ediff1d(x)),
+    ("array_split",
+     lambda m, x: m.array_split(m.array(x), 3, axis=1)[1],
+     lambda x: onp.array_split(x, 3, axis=1)[1]),
+    ("column_stack",
+     lambda m, x: m.column_stack([m.array(x[0]), m.array(x[1])]),
+     lambda x: onp.column_stack([x[0], x[1]])),
+    ("dstack", lambda m, x: m.dstack([m.array(x), m.array(x)]),
+     lambda x: onp.dstack([x, x])),
+    ("take_along_axis",
+     lambda m, x: m.take_along_axis(m.array(x),
+                                    m.argsort(m.array(x), axis=1), 1),
+     lambda x: onp.take_along_axis(x, onp.argsort(x, axis=1), 1)),
+    ("float_power",
+     lambda m, x: m.float_power(m.array(onp.abs(x) + 0.5), 2.5),
+     lambda x: onp.float_power(onp.abs(x) + 0.5, 2.5)),
+    ("remainder",
+     lambda m, x: m.remainder(m.array(x), 0.75),
+     lambda x: onp.remainder(x, onp.float32(0.75))),
+]
+
+
+@pytest.mark.parametrize("case", EXT_FNS, ids=[c[0] for c in EXT_FNS])
+def test_np_extended_surface(case):
+    name, mx_fn, onp_fn = case
+    if not hasattr(np, name):
+        pytest.skip(f"mx.np.{name} absent")
+    x = _r((4, 5), 29)
+    got = mx_fn(np, x)
+    got = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    want = onp_fn(x)
+    assert got.shape == onp.asarray(want).shape, \
+        f"{name}: shape {got.shape} vs numpy {onp.asarray(want).shape}"
+    if onp.asarray(want).dtype.kind == "b":
+        assert onp.dtype(got.dtype).kind == "b", \
+            f"{name}: bool result came back as {got.dtype}"
+        onp.testing.assert_array_equal(got, want)
+    elif onp.asarray(want).dtype.kind in "iu":
+        assert onp.dtype(got.dtype).kind in "iu", \
+            f"{name}: integer result came back as {got.dtype}"
+        onp.testing.assert_array_equal(got, onp.asarray(want))
+    else:
+        onp.testing.assert_allclose(
+            onp.asarray(got, onp.asarray(want).dtype), want,
+            rtol=2e-5, atol=2e-6)
+
+
 def test_npx_set_np_toggles():
     mx.npx.set_np()
     try:

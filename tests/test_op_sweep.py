@@ -123,6 +123,47 @@ def _sweep_override(name):
         "signum_update": lambda: ([w, g, z()], {"lr": 0.01}),
         "nag_mom_update": lambda: ([w, g, z()], {"lr": 0.01}),
         "ftrl_update": lambda: ([w, g, z(), z()], {"lr": 0.01}),
+        # ISSUE 11 satellite burn-down: 15 more former skips run the
+        # real forward sweep on structured inputs
+        "adamw_update": lambda: ([w, g, z(), z()], {"lr": 0.01}),
+        "rmspropalex_update": lambda: ([w, g, z(), z(), z()],
+                                       {"lr": 0.01}),
+        "lars_update": lambda: ([w, g, z()], {"lr": 0.01}),
+        "lamb_update_phase1": lambda: ([w, g, z(), z()], {"t": 1}),
+        "lamb_update_phase2": lambda: ([w, g, nd.array(
+            np.array([1.0], np.float32)), nd.array(
+            np.array([1.0], np.float32))], {"lr": 0.01}),
+        "lamb_full_update": lambda: ([w, g, z(), z()], {"lr": 0.01}),
+        "ctc_loss": lambda: ([nd.array(r.randn(6, 2, 5)
+                                       .astype(np.float32)),
+                              nd.array(np.array([[1, 2], [2, 3]],
+                                                np.float32))], {}),
+        "center_loss": lambda: ([x, nd.array(
+            np.array([0, 1, 2, 3], np.float32)),
+            nd.array(r.randn(5, 5).astype(np.float32))], {}),
+        "im2col": lambda: ([nd.array(r.randn(1, 2, 6, 6)
+                                     .astype(np.float32))],
+                           {"kernel": (3, 3)}),
+        "col2im": lambda: ([nd.array(r.randn(1, 18, 16)
+                                     .astype(np.float32))],
+                           {"output_size": (6, 6), "kernel": (3, 3)}),
+        "contrib.fft": lambda: ([x], {}),
+        "contrib.ifft": lambda: ([nd.array(r.randn(4, 6)
+                                           .astype(np.float32))], {}),
+        "contrib.count_sketch": lambda: ([x, nd.array(
+            np.array([0, 3, 1, 7, 2], np.float32)),
+            nd.array(np.array([1, -1, 1, 1, -1], np.float32))],
+            {"out_dim": 8}),
+        "contrib.box_iou": lambda: ([nd.array(np.array(
+            [[0.1, 0.1, 0.5, 0.5], [0.3, 0.3, 0.9, 0.8],
+             [0.0, 0.2, 0.4, 0.9]], np.float32)),
+            nd.array(np.array([[0.2, 0.2, 0.6, 0.6],
+                               [0.5, 0.1, 0.8, 0.7]], np.float32))], {}),
+        "contrib.dequantize": lambda: ([nd.array(
+            np.array(r.randint(-127, 128, (4, 5)), np.int8),
+            dtype="int8"),
+            nd.array(np.array([-1.0], np.float32)),
+            nd.array(np.array([1.0], np.float32))], {}),
     }
     _OVERRIDE_KEYS = frozenset(table)
     if name is None:
@@ -136,12 +177,6 @@ def _sweep_override(name):
 SYNTH_SKIP = {
     "RNN": "stateful multi-input op; covered by tests/test_gluon_rnn.py",
     "BatchNorm": "aux-state op; covered by test_operator/test_gluon",
-    "ctc_loss": "label/length input contract; covered by gluon CTCLoss "
-                "tests",
-
-    "center_loss": "3-input + aux center; covered by test_operator",
-    "col2im": "needs output_size attr; covered by test_operator",
-    "im2col": "needs kernel attr; covered by test_operator",
     "BatchNormWithReLU": "aux-state op (same contract as BatchNorm); "
                          "covered by test_operator r5 additions",
     "Softmax": "upstream alias of the SoftmaxOutput LOSS head (label "
@@ -162,7 +197,6 @@ SYNTH_SKIP = {
     "contrib.MultiBoxDetection": "test_vision_ops",
     "contrib.Proposal": "test_vision_ops",
     "contrib.MultiProposal": "test_vision_ops",
-    "contrib.box_iou": "corner-format box inputs; test_vision_ops",
     "contrib.PSROIPooling": "roi inputs; test_vision_ops",
     "contrib.DeformableConvolution": "offset inputs; test_vision_ops",
     "contrib.roi_align": "roi inputs; test_vision_ops",
@@ -173,29 +207,18 @@ SYNTH_SKIP = {
     "contrib.quantized_conv": "test_quantization",
     "contrib.quantized_dot": "test_quantization",
     "contrib.quantized_fully_connected": "test_quantization",
-    "contrib.dequantize": "test_quantization",
     "contrib.requantize": "test_quantization",
     # misc structured contracts with their own coverage
-    "contrib.count_sketch": "hash-input contract; test_contrib_ops",
     "contrib.hawkes_ll": "event-sequence contract; test_contrib_ops",
-    "contrib.fft": "complex layout; test_contrib_ops",
-    "contrib.ifft": "complex layout; test_contrib_ops",
     "linalg.tensorinv": "even-order tensor contract; test_operator linalg",
     "linalg.gemm": "4-input axpby contract; test_operator linalg",
-    # optimizer update kernels with multi-phase/fused contracts the flat
-    # (weight, grad, state...) synthesizer can't express — oracle-tested
-    # in test_operator::test_optimizer_ops_match_numpy and exercised
-    # end-to-end by every Trainer/Module test.  The single-buffer family
-    # (adadelta/adagrad/rmsprop/signum/nag/ftrl) now runs the real sweep
-    # via _sweep_override.
-    "adamw_update": "optimizer update; test_operator",
-    "lamb_update_phase1": "optimizer update; test_operator",
-    "lamb_update_phase2": "optimizer update; test_operator",
-    "lamb_full_update": "optimizer update; test_operator",
-    "lars_update": "optimizer update; test_multi_optimizer",
+    # fused multi-tensor optimizer kernels: variadic (w, g, state...)*K
+    # flat-list contract; exercised end-to-end by test_multi_optimizer.
+    # The whole single-param family (adadelta/adagrad/rmsprop/signum/
+    # nag/ftrl and — ISSUE 11 satellite — adamw/rmspropalex/lars/lamb)
+    # now runs the real sweep via _sweep_override.
     "multi_mp_sgd_update": "fused multi-tensor; test_multi_optimizer",
     "multi_mp_sgd_mom_update": "fused multi-tensor; test_multi_optimizer",
-    "rmspropalex_update": "optimizer update; test_operator",
 }
 
 
@@ -338,6 +361,21 @@ FD_SKIP = {
     "adadelta_update": "optimizer update", "adagrad_update": "optimizer update",
     "rmsprop_update": "optimizer update", "signum_update": "optimizer update",
     "nag_mom_update": "optimizer update", "ftrl_update": "optimizer update",
+    "adamw_update": "optimizer update",
+    "rmspropalex_update": "optimizer update",
+    "lars_update": "optimizer update",
+    "lamb_update_phase1": "optimizer update",
+    "lamb_update_phase2": "optimizer update",
+    "lamb_full_update": "optimizer update",
+    "ctc_loss": "loss head: backward is the CTC loss grad; labels are "
+                "integer selectors",
+    "center_loss": "loss head with aux center update (train-mode "
+                   "mutation); backward is the loss grad",
+    "contrib.dequantize": "range inputs kink at |min|==|max| (max of "
+                          "abs); data input is int8",
+    "contrib.fft": "reference layout contract casts to float32 inside; "
+                   "float64 FD precision lost (forward swept)",
+    "contrib.ifft": "float32-inside cast (same as contrib.fft)",
     # loss heads: backward is the LOSS gradient by contract, not
     # d(forward)/dx — FD against the forward is meaningless
     "SoftmaxOutput": "loss head: backward = softmax - label",
@@ -365,7 +403,9 @@ FD_SKIP = {
 # (FD explodes) while the analytic grad is correctly zero — FD checks
 # only the data input
 FD_DATA_INPUT_ONLY = {"SequenceLast", "SequenceMask", "SequenceReverse",
-                      "pick"}
+                      "pick",
+                      # h (bucket indices) and s (signs) are selectors
+                      "contrib.count_sketch"}
 
 
 @pytest.mark.parametrize("name", [

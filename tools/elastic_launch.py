@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Elastic training launcher (ISSUE 11) — the CLI over
+``mxnet_tpu.resilience.ElasticController``.
+
+Where ``tools/launch.py`` is the one-shot dmlc_tracker analog (spawn N
+workers, wait, report), this launcher OWNS the job: it watches
+heartbeats, restarts the world smaller on worker death, grows it back
+after a checkpointed probation, and survives its own death — rerunning
+the same command on the same ``--workdir`` re-adopts a live job or
+finishes an interrupted resize.
+
+Usage:
+  python tools/elastic_launch.py -n 4 --workdir /tmp/job \\
+      [--min-workers 2 --max-restarts 8 --regrow-steps 50 \\
+       --hang-s 60 --straggler-factor 4 --grace-s 10 \\
+       --cpu-devices 1 --ckpt-dir ckpt] \\
+      -- python train.py --my-args
+
+The worker command runs once per rank with injected ``MXNET_DIST_*`` /
+``MXNET_ELASTIC_*`` env; per-rank logs, heartbeats, telemetry shards,
+flight-recorder dumps, and the terminal report roll-up all land under
+``--workdir``.  Exit code 0 = every rank completed; 1 = the job died
+with the restart budget spent (see ``<workdir>/report/``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="elastic multi-process training controller "
+                    "(spawn, watch, resize, survive)")
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="target world size")
+    ap.add_argument("--workdir", required=True,
+                    help="job directory (state file, logs, heartbeats, "
+                         "telemetry, flightrec, report roll-up)")
+    ap.add_argument("--min-workers", type=int, default=None,
+                    help="smallest world to shrink to on worker death "
+                         "(default MXNET_ELASTIC_MIN_WORKERS)")
+    ap.add_argument("--max-restarts", type=int, default=None,
+                    help="unplanned restart budget "
+                         "(default MXNET_ELASTIC_MAX_RESTARTS)")
+    ap.add_argument("--regrow-steps", type=int, default=None,
+                    help="committed checkpoint steps a degraded world "
+                         "runs before growing back "
+                         "(default MXNET_ELASTIC_REGROW_STEPS)")
+    ap.add_argument("--hang-s", type=float, default=None,
+                    help="heartbeat staleness = hang "
+                         "(default MXNET_ELASTIC_HANG_S)")
+    ap.add_argument("--straggler-factor", type=float, default=None,
+                    help="stepclock straggler threshold; 0 disables "
+                         "(default MXNET_ELASTIC_STRAGGLER_FACTOR)")
+    ap.add_argument("--grace-s", type=float, default=None,
+                    help="SIGTERM→SIGKILL drain grace "
+                         "(default MXNET_ELASTIC_GRACE_S)")
+    ap.add_argument("--ckpt-dir", default="ckpt",
+                    help="checkpoint tree (relative to workdir) whose "
+                         "manifest drives resize/regrow decisions")
+    ap.add_argument("--cpu-devices", type=int, default=None,
+                    help="force each worker onto N virtual CPU devices "
+                         "(testing without TPUs)")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="worker command (prefix with -- to separate)")
+    args = ap.parse_args(argv)
+    # strip only the LEADING separator — a later "--" belongs to the
+    # worker command itself
+    command = args.command[1:] \
+        if args.command and args.command[0] == "--" else args.command
+    if not command:
+        ap.error("no worker command given")
+
+    workdir = os.path.abspath(args.workdir)
+    # the controller's own observability rides the job's collection
+    # dirs — FORCED over any ambient redirect (the report roll-up and
+    # the mid-resize postmortems read exactly these paths), and set
+    # BEFORE importing mxnet_tpu so the flight recorder and exit-time
+    # snapshot export arm against them
+    os.environ["MXNET_TELEMETRY"] = "1"
+    os.environ["MXNET_TELEMETRY_DIR"] = os.path.join(workdir, "telemetry")
+    os.environ["MXNET_FLIGHTREC_DIR"] = os.path.join(workdir, "flightrec")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu.resilience import ElasticController, JobFailedError
+
+    ctl = ElasticController(
+        command, args.num_workers, workdir,
+        min_workers=args.min_workers, max_restarts=args.max_restarts,
+        regrow_steps=args.regrow_steps, hang_s=args.hang_s,
+        straggler_factor=args.straggler_factor, grace_s=args.grace_s,
+        cpu_devices_per_worker=args.cpu_devices, ckpt_dir=args.ckpt_dir)
+    try:
+        summary = ctl.run()
+    except JobFailedError as e:
+        print(f"elastic_launch: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(summary, indent=1))
+    return 0 if summary.get("outcome") == "done" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
