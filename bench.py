@@ -81,32 +81,46 @@ def _lane_telemetry():
 
 
 def _telemetry_on():
-    """Enable telemetry for the measured lane, starting from a clean
-    slate — the retry ladder re-enters run_*_once in the SAME process, so
-    without the reset a half-batch row would embed counters and step
-    phases from the failed full-batch attempt.  (Host-side spans/counters
-    only; with scan_steps fused per dispatch the per-dispatch overhead is
-    noise next to the XLA program.)"""
+    """Enable telemetry + the cost ledger for the measured lane, starting
+    from a clean slate — the retry ladder re-enters run_*_once in the SAME
+    process, so without the reset a half-batch row would embed counters
+    and step phases from the failed full-batch attempt.  (Host-side
+    spans/counters only; with scan_steps fused per dispatch the
+    per-dispatch overhead is noise next to the XLA program.  The armed
+    ledger adds one AOT analysis per NEW executable — compile-time, not
+    steady-state, cost.)"""
     from mxnet_tpu import telemetry
     telemetry.enable()
-    telemetry.clear()            # spans + ledger + step-clock window
+    telemetry.costmodel.arm()    # analytic flops/bytes/HBM per executable
+    telemetry.clear()            # spans + ledgers + step-clock window
     telemetry.REGISTRY.reset()   # counters attribute THIS attempt only
 
 
 def _peak_flops(dtype):
-    """Per-chip peak for MFU accounting."""
-    import jax
-    d = jax.devices()[0]
-    if d.platform == "cpu":
-        return 5e11
-    kind = str(getattr(d, "device_kind", "")).lower()
-    if "v4" in kind:
-        bf16_peak = 275e12
-    elif "v5p" in kind:
-        bf16_peak = 459e12
-    else:  # v5e / "TPU v5 lite"
-        bf16_peak = 197e12
-    return bf16_peak if dtype == "bfloat16" else bf16_peak / 4
+    """Per-chip peak for MFU accounting (costmodel's device table)."""
+    from mxnet_tpu.telemetry import costmodel
+    return costmodel.peak_flops(dtype)
+
+
+def _lane_cost(step_seconds, dtype):
+    """The analytic cost block every BENCH row embeds (ISSUE 12): the
+    TrainStep executable's XLA-counted per-step flops/bytes (a scanned
+    program's loop body is analyzed once, so its cost IS one step's),
+    analytic MFU against the measured per-step wall time, the roofline
+    verdict, and the per-device peak-HBM estimate.  Analytic MFU counts
+    ALL flops XLA emits (cost_analysis), so it sits a few % above the
+    hand-derived PaLM-convention `mfu` field — both ride the row
+    (PROFILE.md r10 records the protocol)."""
+    try:
+        from mxnet_tpu.telemetry import costmodel
+        c = costmodel.lane_summary(step_seconds=step_seconds, dtype=dtype)
+        keep = ("flops", "bytes_accessed", "arithmetic_intensity",
+                "ridge_flops_per_byte", "verdict", "roofline_mfu_bound",
+                "analytic_mfu", "peak_hbm_bytes", "compile_s",
+                "executables", "error")
+        return {k: c[k] for k in keep if k in c}
+    except Exception as e:  # noqa: BLE001 — the ledger must not kill a lane
+        return {"error": f"{type(e).__name__}: {e}"[:120]}
 
 
 def run_vision_once(name, batch, dtype, scan_steps, dispatches):
@@ -166,7 +180,8 @@ def run_vision_once(name, batch, dtype, scan_steps, dispatches):
         else 0.0
     extra = {"dtype": dtype, "batch": batch, "size": size,
              "step_ms": round(1000 * dt / n_steps, 2), "loss": last_loss,
-             "telemetry": _lane_telemetry()}
+             "telemetry": _lane_telemetry(),
+             "cost": _lane_cost(dt / n_steps, dtype)}
     if not name.startswith("resnet50"):
         extra["baseline_note"] = "no reference baseline for this model"
     return {
@@ -252,7 +267,8 @@ def run_once(name, batch, seq_len, dtype, scan_steps, dispatches):
         "extra": {"mfu": round(mfu, 4), "dtype": dtype, "batch": batch,
                   "seq_len": seq_len, "scan_steps": scan_steps,
                   "step_ms": round(1000 * dt / n_steps, 2),
-                  "loss": last_loss, "telemetry": _lane_telemetry()},
+                  "loss": last_loss, "telemetry": _lane_telemetry(),
+                  "cost": _lane_cost(dt / n_steps, dtype)},
     }
 
 
@@ -345,7 +361,8 @@ def run_llama_once(batch, seq_len, dtype, scan_steps, dispatches):
         "extra": {"mfu": round(mfu, 4), "dtype": dtype, "batch": batch,
                   "seq_len": seq_len, "scan_steps": scan_steps,
                   "step_ms": round(1000 * dt / n_steps, 2),
-                  "loss": last_loss, "telemetry": _lane_telemetry()},
+                  "loss": last_loss, "telemetry": _lane_telemetry(),
+                  "cost": _lane_cost(dt / n_steps, dtype)},
     }
 
 

@@ -77,6 +77,13 @@ HOT_PATHS = {
     # ack channel — both must stay host-sync-free and flag-disciplined
     "telemetry/stepclock.py": {"begin_step", "note", "end_step"},
     "telemetry/aggregate.py": {"counter_deltas", "absorb_counter_deltas"},
+    # analytic observatory (ISSUE 12): the jit-boundary wrapper sits on
+    # every instrumented dispatch (op dispatch included when armed) and
+    # the scrape handler runs per request on server threads — both must
+    # stay host-sync-free and flag-disciplined
+    "telemetry/costmodel.py": {"__call__", "_probe", "wrap_jit",
+                               "wrap_jit_if_armed", "_on_duration_event"},
+    "telemetry/httpd.py": {"do_GET"},
     # elastic control plane (ISSUE 11): the controller's monitor loop
     # polls several times a second and the heartbeat note sits on the
     # worker's step path — both must stay host-sync-free and

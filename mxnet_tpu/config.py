@@ -66,6 +66,40 @@ KNOWN_VARS = {
         "flight-recorder dumps); rank 0 / tools/telemetry_report.py merge "
         "the shards into ONE Chrome trace and ONE Prometheus snapshot. "
         "Unset = no export."),
+    # analytic performance observatory (ISSUE 12: telemetry.costmodel +
+    # telemetry.httpd)
+    "MXNET_COSTMODEL": (
+        "0", int,
+        "If 1, the compile/cost ledger arms at import: every owned jit "
+        "boundary (op dispatch, TrainStep, fused optimizer/kvstore "
+        "buckets, serving prefill/decode) records per-executable compile "
+        "seconds, XLA cost_analysis flops/bytes, and memory_analysis "
+        "peak-HBM into telemetry.costmodel.LEDGER (report(cost=True), "
+        "/ledger.json, BENCH rows).  0 (default) records nothing; "
+        "costmodel.arm() flips it at runtime."),
+    "MXNET_COSTMODEL_MEMORY": (
+        "1", int,
+        "If 1 (default), the armed cost ledger also AOT-compiles each new "
+        "executable for memory_analysis (argument/output/temp bytes -> "
+        "peak-HBM estimate) — one extra XLA compile per executable; 0 "
+        "keeps the cheap trace-only cost_analysis (flops/bytes) alone."),
+    "MXNET_PEAK_FLOPS": (
+        "0", float,
+        "Per-chip peak FLOP/s for analytic-MFU accounting (0 = auto from "
+        "the device kind: v5e 197e12 bf16, v4 275e12, v5p 459e12, CPU "
+        "5e11; float32 = bf16/4)."),
+    "MXNET_PEAK_HBM_GBS": (
+        "0", float,
+        "Per-chip HBM bandwidth in GB/s for the roofline ridge (0 = auto "
+        "from the device kind: v5e 819, v4 1228, v5p 2765, CPU 50)."),
+    "MXNET_TELEMETRY_PORT": (
+        None, int,
+        "If set, a daemon-thread HTTP server exposes the LIVE telemetry "
+        "plane on this port: /metrics (Prometheus exposition of the "
+        "registry — the scrape surface a replica router dispatches on), "
+        "/statusz (knobs, world, stepclock verdict, serving gauges), "
+        "/ledger.json (cost + op ledgers).  0 binds an ephemeral port; "
+        "unset (default) = no server."),
     "MXNET_STEPCLOCK_WINDOW": (
         "64", int,
         "Steps the StepClock keeps for the rolling input-/comms-/compute-"

@@ -40,6 +40,7 @@ from __future__ import annotations
 import numpy as _np
 
 from .. import telemetry as _tel
+from ..telemetry import costmodel as _costmodel
 
 __all__ = ["GradBucketer", "bucket_bytes_from_env", "tree_sum",
            "DEFAULT_BUCKET_MB"]
@@ -225,7 +226,7 @@ class GradBucketer:
                              if n_keys > 1 else jnp.ravel(chunk[0]))
             return tree_sum(flats)
 
-        return jax.jit(fuse)
+        return _costmodel.wrap_jit(jax.jit(fuse), "kvstore.fusion.reduce")
 
     @staticmethod
     def _build_reduce_keys(n_keys, n_rep):
@@ -237,7 +238,7 @@ class GradBucketer:
                 tree_sum([arrs[r * n_keys + i] for r in range(n_rep)])
                 for i in range(n_keys))
 
-        return jax.jit(fuse)
+        return _costmodel.wrap_jit(jax.jit(fuse), "kvstore.fusion.reduce")
 
     @staticmethod
     def _build_unflatten(shapes, sizes):
@@ -250,7 +251,8 @@ class GradBucketer:
                 off += size
             return tuple(out)
 
-        return jax.jit(unflat)
+        return _costmodel.wrap_jit(jax.jit(unflat),
+                                   "kvstore.fusion.unflatten")
 
 
 # -- telemetry hooks (callers gate on tracer._ENABLED) -----------------------

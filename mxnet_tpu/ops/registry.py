@@ -28,6 +28,7 @@ import time as _time
 from ..base import MXNetError
 from .. import config, engine
 from .. import telemetry as _telemetry
+from ..telemetry import costmodel as _costmodel
 from ..telemetry import tracer as _ttrace
 
 __all__ = ["Op", "register", "get", "list_ops", "invoke", "invoke_arrays"]
@@ -143,6 +144,19 @@ def list_ops():
 _jit_cache: dict = {}
 _jit_lock = threading.Lock()
 
+
+def _costmodel_rearm():
+    """arm()/disarm() flips whether fresh dispatch callables carry the
+    cost-ledger wrapper; drop the built ones so the next dispatch rebuilds
+    through wrap_jit_if_armed under the new mode (the per-op hot path
+    itself stays wrapper-free while disarmed)."""
+    with _jit_lock:
+        _jit_cache.clear()
+    _callable_memo.clear()
+
+
+_costmodel.add_rearm_hook(_costmodel_rearm)
+
 # Pre-dispatch array-cast hook (mxnet_tpu.amp): fn(op_name, arrays) -> arrays,
 # jax-traceable so it folds into jit traces.  _dispatch_epoch bumps whenever
 # the hook changes so shape/dtype-keyed caches (CachedOp) retrace.
@@ -253,7 +267,9 @@ def _build_callable(op, attrs, jit_on):
             return _fn(*arrays, **kw)
 
         with _jit_lock:
-            jf = _jit_cache.setdefault(key, jax.jit(wrapper))
+            jf = _jit_cache.setdefault(
+                key, _costmodel.wrap_jit_if_armed(jax.jit(wrapper),
+                                                  f"op:{op.name}"))
     dyn_vals = tuple(dyn[k] for k in dyn_keys)
     return lambda *arrays: jf(dyn_vals, *arrays)
 

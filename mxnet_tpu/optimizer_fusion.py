@@ -69,6 +69,7 @@ import numpy as _np
 
 from . import config
 from . import telemetry as _tel
+from .telemetry import costmodel as _costmodel
 from .telemetry import tracer as _ttrace
 
 __all__ = ["fusion_enabled", "fusion_active", "supported_kind",
@@ -315,7 +316,8 @@ def _build_exec(kind, mp, has_mom, shapes, sizes, cfg, flat_grad):
             s for role in new_states for s in role)
 
     donate = tuple(range(n)) + tuple(range(base, base + n_roles * n))
-    return jax.jit(fn, donate_argnums=donate)
+    return _costmodel.wrap_jit(jax.jit(fn, donate_argnums=donate),
+                               f"optimizer_fusion.{kind}")
 
 
 # -- apply -------------------------------------------------------------------

@@ -35,6 +35,7 @@ from .base import MXNetError
 from .context import Context
 from . import ndarray as nd
 from . import telemetry as _tel
+from .telemetry import costmodel as _costmodel
 from .telemetry import stepclock as _sclock
 from .telemetry import tracer as _ttrace
 from .ndarray.ndarray import NDArray
@@ -597,8 +598,9 @@ class TrainStep:
         donate = (4, 5) if self._donate else ()
         if _ttrace._ENABLED:
             _M_RETRACES.inc()
-        return jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
-                       donate_argnums=donate)
+        return _costmodel.wrap_jit(
+            jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
+                    donate_argnums=donate), "parallel.TrainStep")
 
     def _build_multi(self, stacked, data_ndim, label_ndim):
         """K steps fused into ONE XLA program via lax.scan.
@@ -638,8 +640,9 @@ class TrainStep:
         donate = (4, 5) if self._donate else ()
         if _ttrace._ENABLED:
             _M_RETRACES.inc()
-        return jax.jit(raw_multi, in_shardings=in_sh, out_shardings=out_sh,
-                       donate_argnums=donate)
+        return _costmodel.wrap_jit(
+            jax.jit(raw_multi, in_shardings=in_sh, out_shardings=out_sh,
+                    donate_argnums=donate), "parallel.TrainStep")
 
     def run(self, data, label, steps=None):
         """Run many fused training steps in ONE jitted dispatch.

@@ -316,13 +316,18 @@ _JIT = {}
 def _jitted():
     if not _JIT:
         import jax
-        _JIT["llama_decode"] = jax.jit(
-            _llama_decode_raw, static_argnums=0, donate_argnums=2)
-        _JIT["llama_prefill"] = jax.jit(
-            _llama_prefill_raw, static_argnums=0, donate_argnums=2)
-        _JIT["tf_encode"] = jax.jit(_tf_encode_raw, static_argnums=0)
-        _JIT["tf_decode"] = jax.jit(
-            _tf_decode_raw, static_argnums=0, donate_argnums=2)
+        from ..telemetry import costmodel as _cm
+        _JIT["llama_decode"] = _cm.wrap_jit(
+            jax.jit(_llama_decode_raw, static_argnums=0, donate_argnums=2),
+            "serving.llama_decode")
+        _JIT["llama_prefill"] = _cm.wrap_jit(
+            jax.jit(_llama_prefill_raw, static_argnums=0,
+                    donate_argnums=2), "serving.llama_prefill")
+        _JIT["tf_encode"] = _cm.wrap_jit(
+            jax.jit(_tf_encode_raw, static_argnums=0), "serving.tf_encode")
+        _JIT["tf_decode"] = _cm.wrap_jit(
+            jax.jit(_tf_decode_raw, static_argnums=0, donate_argnums=2),
+            "serving.tf_decode")
     return _JIT
 
 

@@ -28,6 +28,17 @@ The distributed observability plane (ISSUE 10) sits on top:
   on unhandled exceptions, deadline-exceeded, chaos exits, SIGTERM, and
   SIGUSR2 (``MXNET_FLIGHTREC*`` knobs).
 
+The analytic performance observatory (ISSUE 12) completes the stack:
+
+- **costmodel** — the per-executable compile/cost/memory ledger over
+  every jit boundary the runtime owns (XLA's own flops/bytes/HBM numbers,
+  no hardware needed), analytic MFU + roofline verdicts
+  (``report(cost=True)``, BENCH rows), and the fits-per-shape
+  ``estimate_memory`` API (``MXNET_COSTMODEL`` knobs).
+- **httpd** — the live scrape plane (``MXNET_TELEMETRY_PORT``):
+  ``/metrics`` Prometheus exposition, ``/statusz`` run status,
+  ``/ledger.json``.
+
 Instrumentation ships wired into the runtime chokepoints: op dispatch
 (ops.registry), kvstore push/pull/allreduce, gluon.Trainer step phases,
 DataLoader batch fetch, and checkpoint save/load.  The resilience layer
@@ -45,14 +56,16 @@ from __future__ import annotations
 from .. import config
 from . import ledger, metrics, tracer
 from . import stepclock          # noqa: E402 — needs metrics loaded
+from . import costmodel          # noqa: E402 — needs metrics loaded
 from . import aggregate          # noqa: E402 — needs tracer/metrics/stepclock
 from . import flightrec          # noqa: E402 — needs aggregate
+from . import httpd              # noqa: E402 — needs metrics/costmodel
 from .ledger import record_op
 from .metrics import (  # noqa: F401
     DEFAULT_BUCKETS, REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
     counter, gauge, histogram, to_json, to_prometheus,
 )
-from .stepclock import STEP_CLOCK, StepClock, report  # noqa: F401
+from .stepclock import STEP_CLOCK, StepClock  # noqa: F401
 from .tracer import (  # noqa: F401
     NULL_SPAN, Span, Tracer, chrome_trace, disable, enable, enabled,
     get_tracer, instant, span,
@@ -67,8 +80,19 @@ __all__ = [
     "record_op", "record_dispatch", "ledger", "metrics", "tracer",
     "env_enabled",
     "aggregate", "flightrec", "stepclock", "StepClock", "STEP_CLOCK",
-    "report",
+    "report", "costmodel", "httpd",
 ]
+
+
+def report(clock=None, registry=None, cost=False):
+    """The human-readable observability report: step-time attribution +
+    bottleneck verdict + headline counters (stepclock.report), and — with
+    ``cost=True`` — the analytic cost-ledger table (per-site flops,
+    arithmetic intensity, peak-HBM, roofline verdict)."""
+    out = stepclock.report(clock=clock, registry=registry)
+    if cost:
+        out += "\n" + costmodel.report_text()
+    return out
 
 # -- dispatch instrumentation (fed by ops.registry.invoke) -------------------
 # Handles are created once; the hot path only observes into them.
@@ -95,11 +119,13 @@ def record_dispatch(name, begin_ns, end_ns, hook_ns=0):
 
 
 def clear():
-    """Drop buffered trace events, ledger rows, and the step-clock window
-    (metrics keep counting — use REGISTRY.reset() to zero them)."""
+    """Drop buffered trace events, ledger rows (op aggregate + cost), and
+    the step-clock window (metrics keep counting — use REGISTRY.reset()
+    to zero them)."""
     tracer.clear()
     ledger.clear()
     stepclock.STEP_CLOCK.reset()
+    costmodel.LEDGER.clear()
 
 
 def payload_bytes(value):
@@ -132,6 +158,10 @@ if config.get_int("MXNET_FLIGHTREC", 1):
     flightrec.install()
 if config.get("MXNET_TELEMETRY_DIR"):
     aggregate.install_atexit()
+# analytic observatory (ISSUE 12): the cost ledger arms from its env knob
+# and the live scrape plane serves when a port is named (off by default)
+costmodel.arm_from_env()
+httpd.start_from_env()
 
 
 def env_enabled():

@@ -40,7 +40,7 @@ import threading
 import time
 
 from .. import config
-from . import ledger, metrics, stepclock, tracer
+from . import costmodel, ledger, metrics, stepclock, tracer
 
 __all__ = [
     "set_rank", "rank", "collection_dir", "snapshot", "export_snapshot",
@@ -100,6 +100,7 @@ def snapshot():
         "metrics": metrics.REGISTRY.export_state(),
         "ledger": {k: list(v) for k, v in ledger.snapshot().items()},
         "stepclock": stepclock.STEP_CLOCK.summary(),
+        "costmodel": costmodel.LEDGER.snapshot(),
     }
 
 

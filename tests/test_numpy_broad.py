@@ -525,6 +525,156 @@ def test_np_extended_surface(case):
             rtol=2e-5, atol=2e-6)
 
 
+# ---------------------------------------------------------------------------
+# delegated-surface parity extension round 3 (ISSUE 12 satellite): another
+# ~34-function slice — array surgery (append/delete/insert/splits),
+# selection (compress/extract/select/choose/piecewise), products
+# (inner/vdot/convolve/correlate), index constructors, complex-view and
+# sign helpers, the nan-aware argmin/argmax/cum family, and predicate
+# reducers — again targeting the spots where thin jnp delegation could
+# silently diverge (int/bool result dtypes, axis conventions, nan rules).
+# ---------------------------------------------------------------------------
+
+EXT_FNS3 = [
+    ("append",
+     lambda m, x: m.append(m.array(x), m.array(x[:1]), axis=0),
+     lambda x: onp.append(x, x[:1], axis=0)),
+    ("delete", lambda m, x: m.delete(m.array(x), 2, axis=1),
+     lambda x: onp.delete(x, 2, axis=1)),
+    ("insert",
+     lambda m, x: m.insert(m.array(x), 1, m.array(x[0]), axis=0),
+     lambda x: onp.insert(x, 1, x[0], axis=0)),
+    ("hsplit", lambda m, x: m.hsplit(m.array(x[:, :4]), 2)[1],
+     lambda x: onp.hsplit(x[:, :4], 2)[1]),
+    ("vsplit", lambda m, x: m.vsplit(m.array(x), 2)[0],
+     lambda x: onp.vsplit(x, 2)[0]),
+    ("compress",
+     lambda m, x: m.compress(m.array([0, 1, 1, 0]), m.array(x), axis=0),
+     lambda x: onp.compress([0, 1, 1, 0], x, axis=0)),
+    ("extract",
+     lambda m, x: m.extract(m.array(x) > 0, m.array(x)),
+     lambda x: onp.extract(x > 0, x)),
+    ("select",
+     lambda m, x: m.select([m.array(x) > 1, m.array(x) < -1],
+                           [m.array(x), -m.array(x)], 0.0),
+     lambda x: onp.select([x > 1, x < -1], [x, -x], onp.float32(0.0))),
+    ("choose",
+     lambda m, x: m.choose(m.array(onp.array([0, 1, 1, 0, 1],
+                                             onp.int32)),
+                           [m.array(x[0]), m.array(x[1])]),
+     lambda x: onp.choose(onp.array([0, 1, 1, 0, 1], onp.int32),
+                          [x[0], x[1]])),
+    ("piecewise",
+     lambda m, x: m.piecewise(m.array(x), [m.array(x) < 0,
+                                           m.array(x) >= 0],
+                              [lambda v: -v, lambda v: v * 2]),
+     lambda x: onp.piecewise(x, [x < 0, x >= 0],
+                             [lambda v: -v, lambda v: v * 2])),
+    ("trim_zeros",
+     lambda m, x: m.trim_zeros(m.array(onp.array([0, 0, 1, 2, 0, 3, 0],
+                                                 onp.float32))),
+     lambda x: onp.trim_zeros(onp.array([0, 0, 1, 2, 0, 3, 0],
+                                        onp.float32))),
+    ("inner", lambda m, x: m.inner(m.array(x), m.array(x)),
+     lambda x: onp.inner(x, x)),
+    ("vdot", lambda m, x: m.vdot(m.array(x), m.array(x)),
+     lambda x: onp.vdot(x, x)),
+    ("convolve",
+     lambda m, x: m.convolve(m.array(x[0]),
+                             m.array(onp.array([1.0, 0.5, 0.25],
+                                               onp.float32))),
+     lambda x: onp.convolve(x[0], onp.array([1.0, 0.5, 0.25],
+                                            onp.float32))),
+    ("correlate",
+     lambda m, x: m.correlate(m.array(x[0]), m.array(x[1]), mode="full"),
+     lambda x: onp.correlate(x[0], x[1], mode="full")),
+    ("sinc", lambda m, x: m.sinc(m.array(x)), lambda x: onp.sinc(x)),
+    ("i0", lambda m, x: m.i0(m.array(x[0])), lambda x: onp.i0(x[0])),
+    ("nextafter",
+     lambda m, x: m.nextafter(m.array(x), m.array(x + 1.0)),
+     lambda x: onp.nextafter(x, x + 1.0)),
+    ("tril_indices",
+     lambda m, x: m.tril_indices(4, 0, 5)[0],
+     lambda x: onp.tril_indices(4, 0, 5)[0]),
+    ("triu_indices",
+     lambda m, x: m.triu_indices(4, 1, 5)[1],
+     lambda x: onp.triu_indices(4, 1, 5)[1]),
+    ("diag_indices",
+     lambda m, x: m.diag_indices(4)[0],
+     lambda x: onp.diag_indices(4)[0]),
+    ("diagonal",
+     lambda m, x: m.diagonal(m.array(x), offset=1, axis1=0, axis2=1),
+     lambda x: onp.diagonal(x, offset=1, axis1=0, axis2=1)),
+    ("angle", lambda m, x: m.angle(m.array(x)), lambda x: onp.angle(x)),
+    ("real", lambda m, x: m.real(m.array(x)), lambda x: onp.real(x)),
+    ("imag", lambda m, x: m.imag(m.array(x)), lambda x: onp.imag(x)),
+    ("conj", lambda m, x: m.conj(m.array(x)), lambda x: onp.conj(x)),
+    ("positive", lambda m, x: m.positive(m.array(x)),
+     lambda x: onp.positive(x)),
+    ("negative", lambda m, x: m.negative(m.array(x)),
+     lambda x: onp.negative(x)),
+    ("around", lambda m, x: m.around(m.array(x * 3), 1),
+     lambda x: onp.around(x * 3, 1)),
+    ("nancumsum", lambda m, x: m.nancumsum(m.array(_xnan()[:2]), axis=1),
+     lambda x: onp.nancumsum(_xnan()[:2], axis=1)),
+    ("nanprod", lambda m, x: m.nanprod(m.array(_xnan()[:2]), axis=0),
+     lambda x: onp.nanprod(_xnan()[:2], axis=0)),
+    ("nanargmax", lambda m, x: m.nanargmax(m.array(_xnan()[:2]), axis=1),
+     lambda x: onp.nanargmax(_xnan()[:2], axis=1)),
+    ("nanargmin", lambda m, x: m.nanargmin(m.array(_xnan()[:2]), axis=1),
+     lambda x: onp.nanargmin(_xnan()[:2], axis=1)),
+    ("nanmin", lambda m, x: m.nanmin(m.array(_xnan()[:2]), axis=0),
+     lambda x: onp.nanmin(_xnan()[:2], axis=0)),
+    ("nanvar", lambda m, x: m.nanvar(m.array(_xnan()[:2]), axis=1),
+     lambda x: onp.nanvar(_xnan()[:2], axis=1)),
+    ("nanmedian", lambda m, x: m.nanmedian(m.array(_xnan()[:2]), axis=1),
+     lambda x: onp.nanmedian(_xnan()[:2], axis=1)),
+    ("gradient", lambda m, x: m.gradient(m.array(x), axis=1),
+     lambda x: onp.gradient(x, axis=1)),
+    ("allclose",
+     lambda m, x: m.allclose(m.array(x), m.array(x + 1e-7)),
+     lambda x: onp.allclose(x, x + 1e-7)),
+    ("array_equal",
+     lambda m, x: m.array_equal(m.array(x), m.array(x)),
+     lambda x: onp.array_equal(x, x)),
+]
+
+
+@pytest.mark.parametrize("case", EXT_FNS3, ids=[c[0] for c in EXT_FNS3])
+def test_np_extended_surface_round3(case):
+    name, mx_fn, onp_fn = case
+    if not hasattr(np, name):
+        pytest.skip(f"mx.np.{name} absent")
+    x = _r((4, 5), 37)
+    got = mx_fn(np, x)
+    got = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    want = onp.asarray(onp_fn(x))
+    assert got.shape == want.shape, \
+        f"{name}: shape {got.shape} vs numpy {want.shape}"
+    if want.dtype.kind == "b":
+        assert onp.dtype(got.dtype).kind == "b", \
+            f"{name}: bool result came back as {got.dtype}"
+        onp.testing.assert_array_equal(got, want)
+    elif want.dtype.kind in "iu":
+        assert onp.dtype(got.dtype).kind in "iu", \
+            f"{name}: integer result came back as {got.dtype}"
+        onp.testing.assert_array_equal(got, want)
+    else:
+        onp.testing.assert_allclose(onp.asarray(got, want.dtype), want,
+                                    rtol=2e-5, atol=2e-6)
+
+
+def test_np_dtype_introspection_helpers():
+    """result_type / promote_types / can_cast answer with the x64-less
+    lattice where it AGREES with numpy (the divergent int32+f32 case is
+    pinned by test_np_dtype_promotion)."""
+    assert onp.dtype(np.result_type("float32", "float32")) == onp.float32
+    assert onp.dtype(np.result_type("int32", "int8")) == onp.int32
+    assert onp.dtype(np.promote_types("float32", "float64")) == onp.float64
+    assert bool(np.can_cast("int32", "int64"))
+    assert not bool(np.can_cast("float64", "int32"))
+
+
 def test_npx_set_np_toggles():
     mx.npx.set_np()
     try:

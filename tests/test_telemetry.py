@@ -429,3 +429,88 @@ def test_disabled_dispatch_records_nothing():
     _ = mx.nd.ones((2, 2)) * 2
     assert telemetry.counter("mxnet_op_dispatch_total").value == 0
     assert _events() == []
+
+
+# -- profiler facade paths the ISSUE-12 rewrites left thin -------------------
+
+def test_nested_scope_ledger_and_spans(monkeypatch, tmp_path):
+    """scope() nests: both levels land in the span buffer AND the per-op
+    aggregate ledger, and the inner span lies within the outer one."""
+    import jax
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda *a, **k: None, raising=False)
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: None, raising=False)
+    profiler.set_config(filename=str(tmp_path / "p.json"))
+    profiler.start()
+    with profiler.scope("outer"):
+        _ = (mx.nd.ones((4, 4)) * 2).asnumpy()
+        with profiler.scope("inner"):
+            _ = (mx.nd.ones((4, 4)) + 1).asnumpy()
+    profiler.stop()
+    snap = telemetry.ledger.snapshot()
+    assert snap["scope:outer"][0] == 1
+    assert snap["scope:inner"][0] == 1
+    # a nested scope's time is contained in its parent's
+    assert snap["scope:inner"][1] <= snap["scope:outer"][1]
+    spans = {e["name"]: e for e in _events() if e.get("ph") == "X"}
+    assert {"scope:outer", "scope:inner"} <= set(spans)
+    o, i = spans["scope:outer"], spans["scope:inner"]
+    assert o["ts"] <= i["ts"]
+    assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1  # 1us rounding
+
+
+def test_pause_resume_around_dump(monkeypatch, tmp_path):
+    """pause() stops host recording but dump() still renders what was
+    captured; resume() continues into the same session; stop() after a
+    pause still closes the device trace exactly once."""
+    import jax
+    stops = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda *a, **k: None, raising=False)
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: stops.append(1), raising=False)
+    profiler.set_config(filename=str(tmp_path / "p.json"))
+    profiler.start()
+    with profiler.scope("before_pause"):
+        pass
+    profiler.pause()
+    assert not profiler.is_running()
+    assert profiler._state["xla_trace"]          # device trace stays open
+    with profiler.scope("while_paused"):         # cheap no-op: not recorded
+        pass
+    profiler.dump()                              # dump mid-pause works
+    with open(tmp_path / "p.json") as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "scope:before_pause" in names
+    assert "scope:while_paused" not in names
+    assert "scope:before_pause" in trace["otherData"]["opAggregates"]
+    profiler.resume()
+    with profiler.scope("after_resume"):
+        pass
+    profiler.stop()
+    assert stops == [1]                          # closed exactly once
+    snap = telemetry.ledger.snapshot()
+    assert "scope:after_resume" in snap
+    assert "scope:while_paused" not in snap
+
+
+def test_aggregate_stats_off_with_cost_ledger_armed():
+    """aggregate_stats=False turns the per-op aggregate OFF without
+    touching the ISSUE-12 cost ledger: an armed dispatch still records
+    its executable while the profiler table stays empty."""
+    from mxnet_tpu.telemetry import costmodel
+    profiler.set_config(filename="unused.json", aggregate_stats=False)
+    telemetry.enable()
+    costmodel.LEDGER.clear()
+    costmodel.arm()
+    try:
+        _ = (mx.nd.ones((8, 8)) @ mx.nd.ones((8, 8))).asnumpy()
+        assert telemetry.ledger.snapshot() == {}         # aggregate off
+        sites = {e["site"] for e in costmodel.LEDGER.entries()}
+        assert any(s.startswith("op:") for s in sites)   # ledger on
+        assert telemetry.counter("mxnet_op_dispatch_total").value >= 1
+    finally:
+        costmodel.disarm()
+        costmodel.LEDGER.clear()

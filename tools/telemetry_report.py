@@ -86,6 +86,10 @@ def main(argv=None):
                     help="emit the report as JSON instead of a table")
     ap.add_argument("--all-shards", action="store_true",
                     help="keep every shard (default: newest per rank)")
+    ap.add_argument("--cost", action="store_true",
+                    help="also render each rank's analytic cost ledger "
+                         "(per-site flops / arithmetic intensity / "
+                         "peak-HBM / roofline verdict)")
     args = ap.parse_args(argv)
     if not args.dir:
         ap.error("no collection dir: pass --dir or set MXNET_TELEMETRY_DIR")
@@ -99,6 +103,15 @@ def main(argv=None):
         return 1
 
     rows = [_rank_row(s) for s in snaps]
+    if args.cost:
+        cm = telemetry.costmodel
+        for r, s in zip(rows, snaps):
+            block = s.get("costmodel") or {}
+            summ = cm.summarize_entries(block.get("entries") or (),
+                                        block.get("calls") or {})
+            for site, v in summ.items():
+                v.update(cm.roofline(v["flops"], v["bytes_accessed"]))
+            r["cost"] = summ
     if args.json:
         print(json.dumps({"ranks": rows}, indent=1))
     else:
@@ -119,6 +132,14 @@ def main(argv=None):
         job = max(tally, key=tally.get)
         print(f"job verdict: {job} "
               f"({', '.join(f'{k}×{v}' for k, v in sorted(tally.items()))})")
+        if args.cost:
+            cm = telemetry.costmodel
+            for r in rows:
+                if not r.get("cost"):
+                    continue
+                print(f"cost ledger — rank {r['rank']}:")
+                for line in cm.site_table_lines(r["cost"]):
+                    print(line)
 
     if args.trace:
         with open(args.trace, "w") as f:
