@@ -92,6 +92,16 @@ HOT_PATHS = {
                                  "_read_heartbeats", "_check_hangs",
                                  "_check_straggler", "_manifest_latest"},
     "resilience/heartbeat.py": {"set_step", "beat", "_beater"},
+    # serving router tier (ISSUE 13): the dispatch/ack/reader loops run
+    # per request, the monitor polls several times a second, and the
+    # replica's waiter/handler sit on every ack — all must stay
+    # host-sync-free and flag-disciplined
+    "serving/router.py": {"_dispatch_loop", "_dispatch_one",
+                          "_pick_replica", "_send_to", "_on_ack",
+                          "_reader_loop", "_monitor_loop", "_hedge_scan",
+                          "_respawn_dead", "_check_heartbeats",
+                          "_sweep_queued_deadlines", "_finish_req"},
+    "serving/replica.py": {"_handle", "_waiter", "_send", "_load"},
 }
 
 # GC05 additionally audits these (they sit on the per-batch/per-call path

@@ -353,6 +353,52 @@ KNOWN_VARS = {
         "expired requests are evicted (queued or mid-decode) with "
         "RequestDeadlineExceeded — the serving twin of the resilience "
         "Deadline policy.  0 = no deadline; submit(deadline_s=) overrides."),
+    # serving router tier (ISSUE 13: serving.router + serving.replica —
+    # the *_DIR/INDEX vars are WRITTEN by the router into each replica's
+    # env, the rest tune the router process itself)
+    "MXNET_ROUTER_QUEUE": (
+        "64", int,
+        "Admission bound on requests outstanding in the router (waiting "
+        "for dispatch + dispatched, unfinished).  Submits beyond it are "
+        "shed immediately with RouterOverloaded (mxnet_router_shed_total) "
+        "so overload degrades p99-bounded instead of collapsing."),
+    "MXNET_ROUTER_HEDGE_S": (
+        "0", float,
+        "Tail-latency hedging: a dispatched request unfinished after this "
+        "many seconds is duplicated to a second replica; the first "
+        "completion wins and the loser is cancelled.  0 (default) "
+        "disables hedging."),
+    "MXNET_ROUTER_MAX_RETRIES": (
+        "2", int,
+        "Times the router resubmits one request to a surviving replica "
+        "after the replica serving it died; beyond it the handle fails "
+        "with ReplicaDeadError.  Resubmission re-prefills and is "
+        "token-identical (greedy decode is deterministic)."),
+    "MXNET_ROUTER_MAX_RESPAWNS": (
+        "8", int,
+        "Per-replica respawn budget: crashes beyond it leave the replica "
+        "permanently down (the tier keeps serving on the survivors).  "
+        "Respawns back off with the Retry policy's exponential schedule."),
+    "MXNET_ROUTER_HANG_S": (
+        "20", float,
+        "Replica heartbeat staleness after which the router declares it "
+        "hung, SIGKILLs it, resubmits its in-flight requests, and "
+        "respawns it.  0 disables hang detection."),
+    "MXNET_ROUTER_PING_S": (
+        "1", float,
+        "Idle-load refresh interval: the router pings each replica this "
+        "often so least-loaded dispatch stays fresh between acks."),
+    "MXNET_ROUTER_DIR": (
+        None, str,
+        "Router tier working directory (WRITTEN by the router into each "
+        "replica's env): the replica publishes its RPC port file here "
+        "and the router keeps its state journal, heartbeats, telemetry "
+        "shards, and flight-recorder dumps under it."),
+    "MXNET_ROUTER_INDEX": (
+        None, int,
+        "This replica's index in the router tier (WRITTEN by the router; "
+        "also mirrored into MXNET_DIST_RANK so heartbeat files and "
+        "telemetry shards are rank-tagged per replica)."),
     # native (C++) fast lanes
     "MXNET_USE_NATIVE": (
         "1", int,

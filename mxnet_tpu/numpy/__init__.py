@@ -190,6 +190,20 @@ def _populate():
         if hasattr(_self, name) or not hasattr(jnp, name):
             continue
         setattr(_self, name, _wrap_jnp(name, getattr(jnp, name)))
+    # numpy returns INTEGER counts from an unweighted, non-density
+    # histogram; jnp.histogram hands back floats — cast the counts so
+    # the delegated surface keeps numpy's result-dtype contract
+    _hist_raw = _self.histogram
+
+    def histogram(a, bins=10, range=None, weights=None, density=None):
+        counts, edges = _hist_raw(a, bins=bins, range=range,
+                                  weights=weights, density=density)
+        if weights is None and not density:
+            counts = counts.astype("int64")
+        return counts, edges
+
+    histogram.__doc__ = _hist_raw.__doc__
+    _self.histogram = histogram
     # subnamespaces
     lin = _types.ModuleType(__name__ + ".linalg")
     import jax.numpy.linalg as jla

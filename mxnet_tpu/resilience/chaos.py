@@ -52,9 +52,18 @@ __all__ = [
 # incarnation comes up, resize hits in the crash window between draining
 # the old world and spawning the new one — kind 'exit' there is a real
 # control-plane death, which the controller's state file must survive.
+# ``router.dispatch`` / ``router.replica_spawn`` fire inside the serving
+# ROUTER process (serving/router.py): dispatch hits between journaling a
+# request and sending it to a replica (the router-death crash window),
+# replica_spawn hits before each replica subprocess comes up.
+# ``serving.reply`` fires inside a REPLICA worker (serving/replica.py)
+# after a request's tokens are computed but BEFORE the ack is written —
+# kind 'exit' there is the dedup-on-retry window a router resubmission
+# must cover without duplicating tokens.
 SITES = ("kvstore.allreduce", "dist.barrier", "dataloader.fetch",
          "checkpoint.save", "trainer.step", "io.decode",
-         "controller.spawn", "controller.resize")
+         "controller.spawn", "controller.resize",
+         "router.dispatch", "router.replica_spawn", "serving.reply")
 
 _M_FAULTS = _tel.counter(
     "mxnet_resilience_faults_injected_total",
@@ -183,10 +192,12 @@ def hit(site, **ctx):
 
 
 def arm_from_spec(spec):
-    """Arm faults from a "site:kind[:times[:delay_s]],..." spec string —
-    the MXNET_CHAOS_SITES grammar, callable directly so decode-pool
-    workers can re-arm from the spec their PARENT resolved (a forkserver
-    child may inherit a stale environment)."""
+    """Arm faults from a "site:kind[:times[:delay_s[:after]]],..." spec
+    string — the MXNET_CHAOS_SITES grammar, callable directly so
+    decode-pool workers can re-arm from the spec their PARENT resolved (a
+    forkserver child may inherit a stale environment).  The optional 5th
+    field maps to ``inject(after=)``: skip the first N hits before
+    firing (arming a mid-stream death from the environment)."""
     for part in (spec or "").split(","):
         part = part.strip()
         if not part:
@@ -197,7 +208,9 @@ def arm_from_spec(spec):
             kind = fields[1] if len(fields) > 1 else "transient"
             times = int(fields[2]) if len(fields) > 2 else 1
             delay_s = float(fields[3]) if len(fields) > 3 else 0.0
-            inject(site, kind=kind, times=times, delay_s=delay_s)
+            after = int(fields[4]) if len(fields) > 4 else 0
+            inject(site, kind=kind, times=times, delay_s=delay_s,
+                   after=after)
         except ValueError as exc:
             # a spec typo must not break `import mxnet_tpu` (this runs at
             # import, deep under every module that wires chaos sites)

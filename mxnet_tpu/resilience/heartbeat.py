@@ -46,6 +46,7 @@ from .. import telemetry as _tel
 __all__ = [
     "enabled", "heartbeat_dir", "start", "stop", "beat", "set_phase",
     "set_step", "mark_failed", "mark_done", "read_all", "path_for",
+    "status",
 ]
 
 PREFIX = "hb-rank"
@@ -56,6 +57,7 @@ _stop = None           # threading.Event of the running beater
 _phase = "spawned"
 _step = None
 _error = None
+_last_beat = None      # monotonic time of the last successful beat
 
 
 def enabled():
@@ -97,6 +99,7 @@ def beat(directory=None):
     """Write one heartbeat now (atomic write-then-rename).  Returns the
     path, or None when no directory is configured.  Never raises — a
     full disk must not kill the training step."""
+    global _last_beat
     directory = directory or heartbeat_dir()
     if not directory:
         return None
@@ -111,9 +114,23 @@ def beat(directory=None):
         with open(tmp, "w") as f:
             json.dump(_record(), f)
         os.replace(tmp, path)
+        with _lock:
+            _last_beat = time.monotonic()
         return path
     except OSError:
         return None
+
+
+def status():
+    """In-process liveness view for the /healthz probe: the current
+    phase, whether a beater thread is armed, and the age (seconds) of
+    the last successful beat (None until one lands)."""
+    with _lock:
+        armed = _thread is not None and _thread.is_alive()
+        age = None if _last_beat is None \
+            else max(0.0, time.monotonic() - _last_beat)
+        return {"phase": _phase, "armed": armed,
+                "heartbeat_age_s": age}
 
 
 def set_phase(phase):

@@ -43,10 +43,16 @@ from .engine import (  # noqa: F401
 from .models import (  # noqa: F401
     LlamaServingAdapter, TransformerServingAdapter, make_adapter,
 )
+from .replica import ReplicaServer  # noqa: F401
+from .router import (  # noqa: F401
+    ReplicaDeadError, Router, RouterHandle, RouterOverloaded,
+)
 
 __all__ = [
     "ServingEngine", "Request", "ResultHandle", "ServingError",
     "RequestDeadlineExceeded", "PagedKVCache", "BlockAllocator",
     "CacheOOMError", "LlamaServingAdapter", "TransformerServingAdapter",
     "make_adapter",
+    "Router", "RouterHandle", "RouterOverloaded", "ReplicaDeadError",
+    "ReplicaServer",
 ]

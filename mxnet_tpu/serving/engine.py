@@ -169,6 +169,15 @@ class ResultHandle:
             "preempts": req.preempts,
         }
 
+    def wait(self, timeout_s=None):
+        """Plain bounded wait for the terminal state — True when the
+        request finished (either way).  Unlike :meth:`result` this
+        spawns no Deadline worker thread, which is what lets a replica
+        worker park one waiter per in-flight request without doubling
+        its thread count (the GIL churn is measurable at serving
+        rates)."""
+        return self._req.done.wait(timeout_s)
+
     def result(self, timeout=None):
         """Block for the generated tokens.  The wait itself is bounded by
         ``resilience.Deadline`` (default ``MXNET_KVSTORE_TIMEOUT_S``): if
@@ -268,6 +277,18 @@ class ServingEngine:
             raise MXNetError("max_new_tokens must be >= 1")
         if not req.prompt:
             raise MXNetError("empty prompt")
+        if deadline_s is not None and deadline_s <= 0:
+            # a non-positive remaining budget (a router forwarding an
+            # already-blown deadline) fails at submit — queueing it would
+            # only burn a scheduler sweep before the same eviction.  The
+            # async 'b' still opens the span tree so _evict's 'e' has a
+            # matching begin
+            _ttrace.async_event(
+                "request", "serving.request", "b", req.rid,
+                prompt_tokens=len(req.prompt),
+                max_new_tokens=req.max_new_tokens)
+            self._evict(req, "queued")
+            return ResultHandle(req)
         total = self.adapter.cache_positions(len(req.prompt),
                                              req.max_new_tokens)
         if len(req.prompt) > self.adapter.prefill_tokens \
@@ -301,6 +322,24 @@ class ServingEngine:
             self._queue.append(req)
             _G_QUEUE.set(len(self._queue))
         return ResultHandle(req)
+
+    def load(self):
+        """One ATOMIC (queue_depth, active_slots, free_blocks) snapshot
+        under the scheduler lock — what a replica RPC ack ships to the
+        router for least-loaded dispatch.  The three gauges are also set
+        together at the end of :meth:`step`, but between iterations only
+        this read is guaranteed consistent (a gauge-by-gauge read can
+        straddle an admission)."""
+        with self._lock:
+            return (len(self._queue),
+                    sum(s is not None for s in self._slots),
+                    self.cache.free_blocks)
+
+    @property
+    def free_slots(self):
+        """Decode slots not currently serving (derived from load())."""
+        q, active, _free = self.load()
+        return self.max_batch - active
 
     # -- scheduling core ----------------------------------------------------
 
@@ -425,6 +464,13 @@ class ServingEngine:
             return
         while self._queue and free:
             req = self._queue.popleft()
+            if req.expired(time.perf_counter()):
+                # the sweep above used one `now`, but each admission in
+                # this loop burns a prefill — a request whose deadline
+                # lapsed while earlier admissions ran must fail HERE,
+                # not pay a prefill and get evicted next iteration
+                self._evict(req, "queued")
+                continue
             try:
                 self._admit_one(req, free[0])
             except CacheOOMError as oom:

@@ -1,0 +1,454 @@
+"""Replica worker — one ``ServingEngine`` behind a line-framed socket RPC
+(ISSUE 13 tentpole, worker half).
+
+The router (``serving.router``) supervises N of these as subprocesses.
+Each worker:
+
+- binds a localhost TCP socket and publishes ``{pid, port}`` to an atomic
+  port file in the tier workdir (``replica-<i>.json``) — which is how a
+  RESTARTED router finds and re-adopts a live replica (stdio pipes die
+  with the parent; a socket survives it);
+- beats the ``resilience.heartbeat`` file protocol (the router injects
+  ``MXNET_ELASTIC_HEARTBEAT_DIR`` + ``MXNET_DIST_RANK``), walking
+  ``spawned → bringup → running → done`` so staleness is the router's
+  hang signal and ``telemetry.httpd``'s ``/healthz`` answers 503 when the
+  process wedges;
+- serves one connection at a time (the router is the only client); a
+  dropped connection loops back to ``accept`` so a successor router can
+  reconnect.
+
+Protocol (one JSON object per ``\\n``-terminated line, UTF-8):
+
+    router -> replica:
+      {"op": "submit", "rid": str, "prompt": [int], "max_new_tokens": N,
+       "deadline_s": float|null}
+      {"op": "cancel", "rid": str}          # hedge loser
+      {"op": "ping"}                        # load refresh
+      {"op": "shutdown"}                    # graceful drain end
+
+    replica -> router:
+      {"type": "hello", "pid", "index", "slots", "load": [q, a, f]}
+      {"type": "accepted", "rid", "load"}
+      {"type": "ack", "rid", "ok": true, "tokens": [...], "load"}
+      {"type": "ack", "rid", "ok": false, "error": cls, "message", "load"}
+      {"type": "pong", "load"}
+
+``load`` is the engine's ATOMIC ``(queue_depth, active_slots,
+free_blocks)`` snapshot — the least-loaded dispatch signal, shipped on
+every ack so the router needs no extra scrape round-trip (the live
+``/metrics`` plane stays available for external balancers).
+
+Exactly-once discipline: completed replies are kept in a bounded
+``done`` cache keyed by the ROUTER's rid, so a resubmitted rid — a
+restarted router re-dispatching its journal, or a retry racing a slow
+ack — answers from the cache instead of recomputing, and a rid still in
+flight re-attaches instead of double-submitting.  The ``serving.reply``
+chaos site fires after a result is computed but BEFORE its ack is
+written: kind 'exit' there is the death window a router retry must cover
+without the client ever seeing duplicate tokens.
+
+The RPC/supervision half is deliberately engine-agnostic: anything with
+``submit(prompt, max_new_tokens, deadline_s) -> handle`` / ``load()`` /
+``stop()`` serves, which is how the jax-free stub replica in the fast
+router tests drives the exact same protocol code as the llama CLI below.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import queue as _queue
+import socket
+import sys
+import threading
+
+from .. import config
+from .. import telemetry as _tel
+from ..telemetry import tracer as _ttrace
+from ..base import MXNetError
+from ..resilience import chaos as _chaos
+from ..resilience import heartbeat as _hb
+
+__all__ = ["ReplicaServer", "port_file_path", "read_port_file", "main"]
+
+HOST = "127.0.0.1"
+DONE_CACHE = 256          # completed replies kept for rid dedup
+
+
+def port_file_path(workdir, index):
+    return os.path.join(workdir, f"replica-{int(index):04d}.json")
+
+
+def read_port_file(workdir, index):
+    """Parse a replica's published ``{pid, port, index}`` record, or None
+    (absent / torn — atomic renames make torn rare)."""
+    try:
+        with open(port_file_path(workdir, index)) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return rec if isinstance(rec, dict) and "port" in rec else None
+
+
+class _Pending:
+    __slots__ = ("handle", "cancelled")
+
+    def __init__(self, handle):
+        self.handle = handle
+        self.cancelled = False
+
+
+class ReplicaServer:
+    """Serve one engine over the line-framed RPC above."""
+
+    def __init__(self, engine, workdir, index):
+        self._engine = engine
+        self._workdir = os.path.abspath(workdir)
+        self._index = int(index)
+        self._lsock = None
+        self._conn = None            # current router connection (_wlock)
+        self._lock = threading.Lock()       # pending/done maps + _stop
+        self._wlock = threading.Lock()      # connection swap + line writes
+        self._pending = {}                  # rid -> _Pending
+        self._done = collections.OrderedDict()   # rid -> cached ack
+        self._load_at = 0.0                 # _load TTL cache
+        self._load_val = [0, 0, 0]
+        self._outq = _queue.SimpleQueue()   # replies -> sender thread
+        self._sender = None
+        self._stop = False
+
+    def attach_engine(self, engine):
+        """Late-bind the engine (the CLI binds the socket first so the
+        port file exists while the model still builds)."""
+        self._engine = engine
+
+    # -- wire ---------------------------------------------------------------
+
+    def _load(self):
+        """Engine load triple for acks, cached ~5ms: engine.load() takes
+        the scheduler lock, and a submit/ack burst taking it per line
+        convoys with the decode loop's long lock holds.  The cache races
+        benignly across reader/waiter threads — load is advisory, and a
+        5ms-stale triple is fresher than the router's ping fallback."""
+        import time as _time
+        now = _time.monotonic()
+        if now - self._load_at > 0.005:
+            try:
+                self._load_val = list(self._engine.load())  # graftcheck: ignore[GC04] — advisory TTL cache; concurrent writers both store a valid fresh triple
+            except Exception:  # noqa: BLE001 — load is advisory
+                pass
+            self._load_at = now  # graftcheck: ignore[GC04] — same benign TTL race as _load_val
+        return list(self._load_val)
+
+    def _send(self, obj):
+        """Queue one reply for the sender thread.  Waiter/reader threads
+        do NO wire work — the json+syscall cost on a completion burst
+        otherwise interleaves with the scheduler thread's GIL windows
+        between decode dispatches (measured as inter-step gaps).  A
+        reply that finds no live router connection is dropped; the done
+        cache answers the successor's resubmit."""
+        self._outq.put(obj)
+        return True
+
+    def _sender_loop(self):
+        """Drain the reply queue onto the current connection — batches a
+        burst into one sendall, serializes writes without a lock convoy."""
+        while True:
+            obj = self._outq.get()
+            if obj is None:
+                return
+            batch = [obj]
+            try:
+                while True:
+                    nxt = self._outq.get_nowait()
+                    if nxt is None:
+                        return
+                    batch.append(nxt)
+            except _queue.Empty:
+                pass
+            data = "".join(json.dumps(o) + "\n" for o in batch).encode()
+            with self._wlock:
+                conn = self._conn
+                if conn is None:
+                    continue
+                try:
+                    conn.sendall(data)
+                except OSError:
+                    self._conn = None
+
+    def bind(self):
+        """Listen on an ephemeral localhost port and publish the port
+        file (write-then-rename: a router never reads a torn record)."""
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.bind((HOST, 0))
+        self._lsock.listen(4)
+        port = self._lsock.getsockname()[1]
+        os.makedirs(self._workdir, exist_ok=True)
+        path = port_file_path(self._workdir, self._index)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"pid": os.getpid(), "port": port,
+                       "index": self._index}, f)
+        os.replace(tmp, path)
+        return port
+
+    # -- request lifecycle --------------------------------------------------
+
+    def _waiter(self, rid, handle):
+        """Block for one request's result and ack it (one daemon thread
+        per in-flight request; bounded by the router's admission
+        control).  The engine's own Deadline bounds the wait, so a dead
+        scheduler thread becomes an error ack, not a leaked thread."""
+        try:
+            # wait() + drained result(): no Deadline worker thread per
+            # request (handle.wait exists for exactly this caller)
+            if hasattr(handle, "wait"):
+                handle.wait(config.get_float(
+                    "MXNET_KVSTORE_TIMEOUT_S", 300.0))
+            tokens = handle.result(timeout=5.0)
+            reply = {"type": "ack", "rid": rid, "ok": True,
+                     "tokens": [int(t) for t in tokens]}
+        except Exception as exc:  # noqa: BLE001 — shipped to the router
+            reply = {"type": "ack", "rid": rid, "ok": False,
+                     "error": type(exc).__name__,
+                     "message": str(exc)[:300]}
+        with self._lock:
+            p = self._pending.pop(rid, None)
+            cancelled = p is not None and p.cancelled
+            if not cancelled:
+                self._done[rid] = reply
+                while len(self._done) > DONE_CACHE:
+                    self._done.popitem(last=False)
+        if cancelled:
+            return            # hedge loser: computed, deliberately unacked
+        # the dedup-on-retry window: the result exists, the ack does not.
+        # kind 'exit' here is the replica death a router resubmission must
+        # make invisible (the survivor recomputes token-identically)
+        if _chaos._ACTIVE:
+            _chaos.hit("serving.reply", rid=rid)
+        _ttrace.async_event("replica_reply", "router.request", "n", rid,
+                            replica=self._index, ok=reply["ok"])
+        self._send(dict(reply, load=self._load()))
+
+    def _submit_one(self, rec):
+        """Admit one submit record.  Returns a CACHED final ack when the
+        rid already completed (restarted-router resubmit: recomputing
+        would be wasted prefill, acking different content would break
+        exactly-once), else None — a rid already in flight re-attaches
+        (the waiter acks to whichever connection is current).  The
+        accepted ack is the caller's job (batched)."""
+        rid = str(rec["rid"])
+        with self._lock:
+            cached = self._done.get(rid)
+            pending = rid in self._pending
+        if cached is not None:
+            return cached
+        if pending:
+            return None
+        _ttrace.async_event("replica_accept", "router.request", "n",
+                            rid, replica=self._index)
+        handle = self._engine.submit(
+            list(rec.get("prompt") or []),
+            max_new_tokens=int(rec.get("max_new_tokens", 32)),
+            deadline_s=rec.get("deadline_s"))
+        with self._lock:
+            self._pending[rid] = _Pending(handle)
+        threading.Thread(target=self._waiter, args=(rid, handle),
+                         daemon=True,
+                         name=f"mx-replica-wait-{rid}").start()
+        return None
+
+    def _handle(self, msg):
+        """Dispatch one parsed request line.  Returns False to end the
+        accept loop (shutdown)."""
+        op = msg.get("op")
+        if op == "submit":
+            cached = self._submit_one(msg)
+            if cached is not None:
+                self._send(dict(cached, load=self._load()))
+            else:
+                self._send({"type": "accepted", "rid": msg.get("rid"),
+                            "load": self._load()})
+        elif op == "submit_batch":
+            reqs = msg.get("reqs") or []
+            for rec in reqs:
+                cached = self._submit_one(rec)
+                if cached is not None:
+                    self._send(dict(cached, load=self._load()))
+            self._send({"type": "accepted",
+                        "rids": [r.get("rid") for r in reqs],
+                        "load": self._load()})
+        elif op == "cancel":
+            rid = str(msg.get("rid"))
+            with self._lock:
+                p = self._pending.get(rid)
+                if p is not None:
+                    p.cancelled = True
+                self._done.pop(rid, None)
+            _ttrace.async_event("replica_cancel", "router.request", "n",
+                                rid, replica=self._index)
+            # cancels are rare (hedge losers) — an append-only log line
+            # makes "the loser was really cancelled" externally checkable
+            try:
+                with open(os.path.join(
+                        self._workdir,
+                        f"cancels-{self._index:04d}.log"), "a") as f:
+                    f.write(rid + "\n")
+            except OSError:
+                pass
+        elif op == "ping":
+            self._send({"type": "pong", "load": self._load()})
+        elif op == "shutdown":
+            self._send({"type": "bye"})
+            return False
+        return True
+
+    def _serve_conn(self, conn):
+        """One router connection: hello, then request lines until EOF or
+        shutdown.  Returns False when the worker should exit."""
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._wlock:
+            self._conn = conn
+        self._send({"type": "hello", "pid": os.getpid(),
+                    "index": self._index,
+                    "slots": getattr(self._engine, "max_batch", None),
+                    "load": self._load()})
+        keep = True
+        try:
+            with conn.makefile("r", encoding="utf-8") as rfile:
+                for line in rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        msg = json.loads(line)
+                    except ValueError:
+                        continue      # torn line from a dying router
+                    keep = self._handle(msg)
+                    if not keep:
+                        break
+        except OSError:
+            pass
+        with self._wlock:
+            if self._conn is conn:
+                self._conn = None
+        try:
+            conn.close()
+        except OSError:
+            pass
+        return keep
+
+    def run(self):
+        """Accept loop: one router at a time; a dropped router loops back
+        to accept so its restarted successor can re-adopt this replica."""
+        if self._lsock is None:
+            self.bind()
+        self._sender = threading.Thread(target=self._sender_loop,
+                                        daemon=True,
+                                        name="mx-replica-send")
+        self._sender.start()
+        while True:
+            with self._lock:
+                if self._stop:
+                    break
+            try:
+                conn, _addr = self._lsock.accept()
+            except OSError:
+                break
+            if not self._serve_conn(conn):
+                break
+        self.close()
+
+    def close(self):
+        with self._lock:
+            self._stop = True
+        self._outq.put(None)        # sender sentinel
+        sock, self._lsock = self._lsock, None  # graftcheck: ignore[GC04] — _lsock swap races only with accept(), whose OSError path is the intended wakeup
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        try:
+            self._engine.stop()
+        except Exception:  # noqa: BLE001 — shutdown is best-effort
+            pass
+
+
+# -- CLI (the real-model worker the router spawns) ---------------------------
+
+def _build_engine(args):
+    """Deterministic llama build: every replica spawned with the same
+    (model, vocab, seed) holds bit-identical weights, which is what makes
+    a retried request's re-prefill on a survivor token-identical."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from ..gluon.model_zoo import llama
+    from .engine import ServingEngine
+
+    mx.random.seed(args.seed)
+    np.random.seed(args.seed)
+    net = llama.llama_model(args.model, vocab_size=args.vocab)
+    net.initialize(mx.initializer.Normal(0.05))
+    net(mx.nd.array(np.zeros((1, 4), np.int32)))    # finish deferred init
+    eng = ServingEngine(
+        net, eos_id=args.eos, max_batch=args.max_batch,
+        block_tokens=args.block_tokens, max_seq=args.max_seq,
+        prefill_tokens=args.prefill_tokens)
+    eng.start()
+    return eng
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="serving replica worker (spawned by serving.router)")
+    ap.add_argument("--workdir",
+                    default=config.get("MXNET_ROUTER_DIR"))
+    ap.add_argument("--index", type=int,
+                    default=config.get_int("MXNET_ROUTER_INDEX", 0))
+    ap.add_argument("--model", default="llama_tiny")
+    ap.add_argument("--vocab", type=int, default=101)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--eos", type=int, default=-1)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--block-tokens", type=int, default=None)
+    ap.add_argument("--max-seq", type=int, default=None)
+    ap.add_argument("--prefill-tokens", type=int, default=None)
+    args = ap.parse_args(argv)
+    if not args.workdir:
+        raise MXNetError("replica worker needs --workdir "
+                         "(or MXNET_ROUTER_DIR in the env)")
+    # GIL switch interval: the scheduler thread re-acquires the GIL
+    # after EVERY XLA dispatch returns; at the default 5ms interval a
+    # submit burst on the reader thread turns each ~1ms prefill into
+    # ~16ms of convoy (measured — it halved the 2-replica scale-out
+    # ratio).  1ms bounds the handoff; going lower starts preempting
+    # the scheduler thread's own host work between dispatches (0.5ms
+    # measured ~15% slower end-to-end).
+    sys.setswitchinterval(0.001)
+    _tel.aggregate.set_rank(args.index)
+    _ttrace.get_tracer().set_process_label(
+        f"mxnet_tpu replica {args.index}")
+    _hb.start()
+    _hb.set_phase("bringup")
+    # bind + publish the port file BEFORE the (slow) model build: a
+    # router can then connect — and a RESTARTED router re-adopt — a
+    # still-compiling replica; early submits just wait in the socket
+    # buffer until the accept loop starts below
+    srv = ReplicaServer(None, args.workdir, args.index)
+    srv.bind()
+    try:
+        srv.attach_engine(_build_engine(args))
+    except Exception as exc:  # noqa: BLE001 — surfaced to the router
+        _hb.mark_failed(exc)
+        raise
+    _hb.set_phase("running")
+    srv.run()
+    _hb.mark_done()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

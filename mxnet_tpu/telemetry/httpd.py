@@ -17,6 +17,12 @@ port — tests) or :func:`start`:
   telemetry/costmodel arming states;
 - ``GET /ledger.json`` — the cost ledger (per-executable flops/bytes/
   peak-HBM records) plus the per-op aggregate ledger;
+- ``GET /healthz``     — liveness probe fed by the resilience heartbeat
+  (ISSUE 13): 200 + ``{phase, heartbeat_age_s}`` while the armed beater
+  is fresh, 503 once it goes stale past ``MXNET_ROUTER_HANG_S`` — what
+  the serving router (and any external load balancer) scrapes to decide
+  a replica is still worth dispatching to.  A process with no heartbeat
+  armed answers 200 (the HTTP reply itself proves the process serves);
 - ``GET /``            — a plain-text index.
 
 Scrapes never block instrumentation: handlers only *read* the registry
@@ -81,6 +87,23 @@ def _ledger_json():
     }
 
 
+def _healthz():
+    """(status_code, body_dict) from the resilience heartbeat.  Stale =
+    the armed beater has not landed a beat within MXNET_ROUTER_HANG_S
+    (the same staleness bound the router's out-of-band hb-file check
+    uses, so the two probes agree)."""
+    from ..resilience import heartbeat
+    st = heartbeat.status()
+    st["ok"] = True
+    if st["armed"]:
+        stale_s = config.get_float("MXNET_ROUTER_HANG_S", 20.0)
+        age = st["heartbeat_age_s"]
+        if stale_s > 0 and (age is None or age > stale_s):
+            st["ok"] = False
+            return 503, st
+    return 200, st
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "mxnet-tpu-telemetry"
 
@@ -97,11 +120,25 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/ledger.json":
                 body = json.dumps(_ledger_json(), default=str).encode()
                 ctype = "application/json"
+            elif path == "/healthz":
+                code, health = _healthz()
+                body = json.dumps(health).encode()
+                ctype = "application/json"
+                if code != 200:
+                    # send_error would wrap the body in HTML; a liveness
+                    # probe wants the JSON payload with the 503
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
             elif path == "/":
                 body = (b"mxnet_tpu telemetry\n"
                         b"  /metrics     Prometheus exposition\n"
                         b"  /statusz     run status JSON\n"
-                        b"  /ledger.json cost + op ledgers\n")
+                        b"  /ledger.json cost + op ledgers\n"
+                        b"  /healthz     heartbeat liveness probe\n")
                 ctype = "text/plain; charset=utf-8"
             else:
                 self.send_error(404, "unknown endpoint")

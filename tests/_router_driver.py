@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Router-tier driver — the subprocess the router-death chaos tests run.
+
+Run 1 submits a request file through a ``serving.Router`` and (when
+``--dispatch-exit-after K`` arms the ``router.dispatch`` chaos site)
+DIES mid-dispatch: the chaos 'exit' fires inside the dispatcher thread
+after K dispatches, dumping a flight-recorder postmortem and pulling the
+plug with requests journaled-but-unsent — the exact crash window the
+router's write-ahead journal exists for.  Run 2 (``--resume``) restarts
+the router on the same workdir: it re-adopts the live replicas through
+their port files, re-dispatches the journal (``router.recovered()``),
+and this driver submits whatever its request file says is still missing,
+then writes every result to ``--out``.
+
+Progress (submits/sheds, with elapsed seconds) is appended to
+``progress.log`` line-by-line as it happens, so a killed run 1 still
+leaves the shed/fail-fast evidence the test asserts on.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("-n", "--nreplicas", type=int, default=2)
+    ap.add_argument("--replica-cmd", default=None,
+                    help="replica argv as a JSON list (default: the "
+                         "jax-free stub worker)")
+    ap.add_argument("--replica-env", default=None,
+                    help="JSON {index: {ENV: VAL}} per-replica env")
+    ap.add_argument("--requests", required=True,
+                    help="JSON list of {tag, prompt, max_new_tokens"
+                         "[, deadline_s]}")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--queue-max", type=int, default=64)
+    ap.add_argument("--hedge-s", type=float, default=0.0)
+    ap.add_argument("--max-retries", type=int, default=2)
+    ap.add_argument("--max-respawns", type=int, default=8)
+    ap.add_argument("--hang-s", type=float, default=20.0)
+    ap.add_argument("--dispatch-exit-after", type=int, default=None)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--result-timeout", type=float, default=120.0)
+    ap.add_argument("--keep-replicas", action="store_true",
+                    help="leave replicas running at exit (a later "
+                         "--resume run re-adopts them)")
+    args = ap.parse_args(argv)
+
+    workdir = os.path.abspath(args.workdir)
+    # the router's own lane must land in the tier's collection dirs,
+    # BEFORE mxnet_tpu imports (flightrec/atexit arm against these)
+    os.environ["MXNET_TELEMETRY"] = "1"
+    os.environ["MXNET_TELEMETRY_DIR"] = os.path.join(workdir, "telemetry")
+    os.environ["MXNET_FLIGHTREC_DIR"] = os.path.join(workdir, "flightrec")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.resilience import chaos
+    from mxnet_tpu.serving.router import Router, RouterOverloaded
+
+    if args.dispatch_exit_after is not None:
+        chaos.inject("router.dispatch", kind="exit",
+                     after=args.dispatch_exit_after, times=1)
+
+    cmd = json.loads(args.replica_cmd) if args.replica_cmd else \
+        [sys.executable, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "_stub_replica.py")]
+    env_per = {int(k): v for k, v in
+               json.loads(args.replica_env).items()} \
+        if args.replica_env else None
+
+    with open(args.requests) as f:
+        want = json.load(f)
+
+    progress = open(os.path.join(workdir, "progress.log"), "a")
+
+    def note(kind, tag, t0):
+        progress.write(f"{kind} {tag} {time.perf_counter() - t0:.4f}\n")
+        progress.flush()
+
+    router = Router(cmd, args.nreplicas, workdir,
+                    queue_max=args.queue_max, hedge_s=args.hedge_s,
+                    max_retries=args.max_retries,
+                    max_respawns=args.max_respawns,
+                    hang_s=args.hang_s, env_per_replica=env_per).start()
+    handles = dict(router.recovered()) if args.resume else {}
+    t0 = time.perf_counter()
+    shed = []
+    for rec in want:
+        tag = rec["tag"]
+        if tag in handles:
+            continue
+        try:
+            handles[tag] = router.submit(
+                rec["prompt"], rec.get("max_new_tokens", 8),
+                deadline_s=rec.get("deadline_s"), tag=tag)
+            note("submitted", tag, t0)
+        except RouterOverloaded:
+            shed.append(tag)
+            note("shed", tag, t0)
+
+    results = {}
+    for tag, h in handles.items():
+        try:
+            results[tag] = {"tokens": h.result(
+                timeout=args.result_timeout)}
+        except Exception as exc:  # noqa: BLE001 — recorded for the test
+            results[tag] = {"error": type(exc).__name__,
+                            "message": str(exc)[:200]}
+    for tag in shed:
+        results.setdefault(tag, {"error": "RouterOverloaded"})
+
+    out = {
+        "results": results,
+        "shed": shed,
+        "replicas": router.replica_status(),
+        "counters": {
+            name: telemetry.REGISTRY.get(name).value
+            for name in ("mxnet_router_dispatched_total",
+                         "mxnet_router_retries_total",
+                         "mxnet_router_hedges_total",
+                         "mxnet_router_shed_total",
+                         "mxnet_router_replica_deaths_total",
+                         "mxnet_router_respawns_total")
+            if telemetry.REGISTRY.get(name) is not None
+        },
+    }
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1)
+    os.replace(tmp, args.out)
+    router.stop(shutdown_replicas=not args.keep_replicas)
+    progress.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""jax-free stub replica worker — the fast router tests' engine.
+
+Drives the EXACT protocol/supervision code the real llama replica uses
+(``serving.replica.ReplicaServer``) with a deterministic token oracle,
+so the router failure matrix (death, ack-window death, hedge, shed,
+hang, re-adoption) runs in milliseconds per request instead of paying a
+jit compile per replica.
+
+Oracle: ``tokens[k] = (sum(prompt) % 97 * 31 + k) % 97`` — replica- and
+batching-independent, so a retried request's output on a survivor is
+token-identical by construction, mirroring the greedy-decode determinism
+of identically seeded real replicas.
+
+Failure knobs (env):
+  STUB_TOKEN_DELAY_S   per-token sleep (load / hedging / shed tests)
+  STUB_DIE_TOKEN       prompt containing this token => os._exit(1)
+                       BEFORE computing (death mid-decode)
+  STUB_WEDGE_TOKEN     prompt containing this token => stop the
+                       heartbeat and block the RPC thread forever (the
+                       hang the router must SIGKILL out of the tier)
+  STUB_ONCE_MARKER     marker-file path making die/wedge fire ONCE
+                       across respawns (the respawned twin must serve)
+  MXNET_CHAOS(_SITES)  the usual chaos grammar; ``serving.reply:exit:1``
+                       is the ack-window death.
+"""
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.resilience import heartbeat as hb              # noqa: E402
+from mxnet_tpu.serving.engine import RequestDeadlineExceeded  # noqa: E402
+from mxnet_tpu.serving.replica import ReplicaServer           # noqa: E402
+
+
+def oracle_tokens(prompt, max_new_tokens):
+    s = sum(int(t) for t in prompt) % 97
+    return [(s * 31 + k) % 97 for k in range(int(max_new_tokens))]
+
+
+class _Handle:
+    def __init__(self):
+        self._ev = threading.Event()
+        self.tokens = None
+        self.error = None
+
+    def wait(self, timeout_s=None):
+        return self._ev.wait(timeout_s)
+
+    def result(self, timeout=None):
+        self._ev.wait(timeout if timeout else 300.0)
+        if self.error is not None:
+            raise self.error
+        if self.tokens is None:
+            raise RequestDeadlineExceeded("stub handle never resolved")
+        return list(self.tokens)
+
+
+class StubEngine:
+    max_batch = 4
+
+    def __init__(self):
+        self.delay = float(os.environ.get("STUB_TOKEN_DELAY_S", "0"))
+        self.die_token = int(os.environ.get("STUB_DIE_TOKEN", "-1"))
+        self.wedge_token = int(os.environ.get("STUB_WEDGE_TOKEN", "-1"))
+        self.once_marker = os.environ.get("STUB_ONCE_MARKER", "")
+        self._lock = threading.Lock()
+        self._queued = 0
+        self._active = 0
+
+    def _fire_once(self):
+        """Destructive triggers fire once per marker file, so the
+        respawned/surviving twin serves the retried request instead of
+        dying on the same prompt forever."""
+        if not self.once_marker:
+            return True
+        try:
+            fd = os.open(self.once_marker,
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+            return True
+        except FileExistsError:
+            return False
+
+    def submit(self, prompt, max_new_tokens=32, deadline_s=None):
+        prompt = [int(t) for t in prompt]
+        if self.die_token in prompt and self._fire_once():
+            os._exit(1)                     # death before any token
+        if self.wedge_token in prompt and self._fire_once():
+            hb.stop()                       # heartbeat goes stale...
+            time.sleep(10000)               # ...and the RPC thread hangs
+        h = _Handle()
+        with self._lock:
+            self._queued += 1
+
+        def work():
+            with self._lock:
+                self._queued -= 1
+                self._active += 1
+            t0 = time.monotonic()
+            try:
+                for _ in range(int(max_new_tokens)):
+                    if self.delay:
+                        time.sleep(self.delay)
+                    if deadline_s is not None \
+                            and time.monotonic() - t0 > float(deadline_s):
+                        h.error = RequestDeadlineExceeded(
+                            f"stub request blew its {deadline_s}s budget")
+                        return
+                h.tokens = oracle_tokens(prompt, max_new_tokens)
+            finally:
+                with self._lock:
+                    self._active -= 1
+                h._ev.set()
+
+        threading.Thread(target=work, daemon=True).start()
+        return h
+
+    def load(self):
+        with self._lock:
+            return (self._queued, self._active, 999)
+
+    def stop(self):
+        pass
+
+
+def main():
+    workdir = os.environ["MXNET_ROUTER_DIR"]
+    index = int(os.environ["MXNET_ROUTER_INDEX"])
+    hb.start()
+    hb.set_phase("bringup")
+    srv = ReplicaServer(StubEngine(), workdir, index)
+    srv.bind()
+    hb.set_phase("running")
+    srv.run()
+    hb.mark_done()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -314,6 +314,37 @@ def test_httpd_statusz_and_ledger(server, armed):
     assert "/metrics" in body
 
 
+def test_httpd_healthz_probe(server, monkeypatch, tmp_path):
+    """ISSUE 13 satellite: /healthz is the router's liveness probe —
+    200 with no heartbeat armed (the reply itself proves liveness), 200
+    + {phase, heartbeat_age_s} while the armed beater is fresh, 503 once
+    it goes stale past MXNET_ROUTER_HANG_S."""
+    from mxnet_tpu.resilience import heartbeat as hb
+    status, ctype, body = _get(server, "/healthz")
+    rec = json.loads(body)
+    assert status == 200 and ctype == "application/json"
+    assert rec["ok"] and not rec["armed"]
+    monkeypatch.setenv("MXNET_ELASTIC_HEARTBEAT_DIR", str(tmp_path))
+    try:
+        # long interval: exactly one beat lands, then we age it by hand
+        assert hb.start(interval_s=600)
+        status, _c, body = _get(server, "/healthz")
+        rec = json.loads(body)
+        assert status == 200 and rec["ok"] and rec["armed"]
+        assert rec["phase"] == "spawned"
+        assert rec["heartbeat_age_s"] < 30
+        import time as _time
+        monkeypatch.setattr(hb, "_last_beat",
+                            _time.monotonic() - 10_000)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(server, "/healthz")
+        assert ei.value.code == 503
+        stale = json.loads(ei.value.read())
+        assert not stale["ok"] and stale["heartbeat_age_s"] > 100
+    finally:
+        hb.stop()
+
+
 def test_httpd_404_and_stop():
     port = httpd.start(port=0, host="127.0.0.1")
     assert httpd.running() and httpd.port() == port

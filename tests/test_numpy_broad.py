@@ -664,6 +664,145 @@ def test_np_extended_surface_round3(case):
                                     rtol=2e-5, atol=2e-6)
 
 
+# ---------------------------------------------------------------------------
+# delegated-surface parity extension round 4 (ISSUE 13 satellite): another
+# ~32-function slice toward the ~250-function namespace — stacking/split
+# helpers, integer bitwise/shift ops (int result dtypes asserted), nan/inf
+# predicates, angle conversions, histogramming, index-grid constructors
+# (indices/ravel_multi_index/unravel_index), *_like constructors, the
+# predicate-reduction aliases (all/any/amax/amin), and take/rollaxis/
+# broadcast_arrays — again the thin-jnp-delegation spots where axis
+# conventions and result dtypes could silently diverge.
+# ---------------------------------------------------------------------------
+
+def _xi():
+    return onp.array([[5, 3, 12, 6, 9], [2, 7, 1, 8, 4]], onp.int32)
+
+
+EXT_FNS4 = [
+    ("absolute", lambda m, x: m.absolute(m.array(x)),
+     lambda x: onp.absolute(x)),
+    ("all", lambda m, x: m.all(m.array(x) > -100, axis=0),
+     lambda x: onp.all(x > -100, axis=0)),
+    ("any", lambda m, x: m.any(m.array(x) > 1, axis=1),
+     lambda x: onp.any(x > 1, axis=1)),
+    ("amax", lambda m, x: m.amax(m.array(x), axis=1),
+     lambda x: onp.amax(x, axis=1)),
+    ("amin", lambda m, x: m.amin(m.array(x), axis=0),
+     lambda x: onp.amin(x, axis=0)),
+    ("atleast_1d", lambda m, x: m.atleast_1d(m.array(x[0, 0])),
+     lambda x: onp.atleast_1d(onp.float32(x[0, 0]))),
+    ("atleast_3d", lambda m, x: m.atleast_3d(m.array(x)),
+     lambda x: onp.atleast_3d(x)),
+    ("bitwise_and", lambda m, x: m.bitwise_and(m.array(_xi()),
+                                               m.array(_xi() + 1)),
+     lambda x: onp.bitwise_and(_xi(), _xi() + 1)),
+    ("bitwise_or", lambda m, x: m.bitwise_or(m.array(_xi()),
+                                             m.array(_xi() + 1)),
+     lambda x: onp.bitwise_or(_xi(), _xi() + 1)),
+    ("bitwise_xor", lambda m, x: m.bitwise_xor(m.array(_xi()),
+                                               m.array(_xi() + 1)),
+     lambda x: onp.bitwise_xor(_xi(), _xi() + 1)),
+    ("invert", lambda m, x: m.invert(m.array(_xi())),
+     lambda x: onp.invert(_xi())),
+    ("left_shift", lambda m, x: m.left_shift(m.array(_xi()), 2),
+     lambda x: onp.left_shift(_xi(), 2)),
+    ("right_shift", lambda m, x: m.right_shift(m.array(_xi()), 1),
+     lambda x: onp.right_shift(_xi(), 1)),
+    ("broadcast_arrays",
+     lambda m, x: m.broadcast_arrays(m.array(x[:1]), m.array(x))[0],
+     lambda x: onp.broadcast_arrays(x[:1], x)[0]),
+    ("conjugate", lambda m, x: m.conjugate(m.array(x)),
+     lambda x: onp.conjugate(x)),
+    ("copy", lambda m, x: m.copy(m.array(x)), lambda x: onp.copy(x)),
+    ("deg2rad", lambda m, x: m.deg2rad(m.array(x * 90)),
+     lambda x: onp.deg2rad(x * 90)),
+    ("rad2deg", lambda m, x: m.rad2deg(m.array(x)),
+     lambda x: onp.rad2deg(x)),
+    ("dsplit", lambda m, x: m.dsplit(m.array(x.reshape(2, 5, 2)), 2)[1],
+     lambda x: onp.dsplit(x.reshape(2, 5, 2), 2)[1]),
+    ("fix", lambda m, x: m.fix(m.array(x * 3)),
+     lambda x: onp.fix(x * 3)),
+    ("full_like", lambda m, x: m.full_like(m.array(x), 2.5),
+     lambda x: onp.full_like(x, 2.5)),
+    ("ones_like", lambda m, x: m.ones_like(m.array(_xi())),
+     lambda x: onp.ones_like(_xi())),
+    ("histogram",
+     lambda m, x: m.histogram(m.array(x), bins=5,
+                              range=(-3.0, 3.0))[0],
+     lambda x: onp.histogram(x, bins=5, range=(-3.0, 3.0))[0]),
+    ("hstack",
+     lambda m, x: m.hstack((m.array(x), m.array(x[:, :2]))),
+     lambda x: onp.hstack((x, x[:, :2]))),
+    ("vstack",
+     lambda m, x: m.vstack((m.array(x), m.array(x[:1]))),
+     lambda x: onp.vstack((x, x[:1]))),
+    ("indices", lambda m, x: m.indices((3, 4))[1],
+     lambda x: onp.indices((3, 4))[1]),
+    ("ravel_multi_index",
+     lambda m, x: m.ravel_multi_index(
+         (m.array(onp.array([0, 1, 2], onp.int32)),
+          m.array(onp.array([3, 0, 4], onp.int32))), (4, 5)),
+     lambda x: onp.ravel_multi_index(
+         (onp.array([0, 1, 2]), onp.array([3, 0, 4])), (4, 5))),
+    ("unravel_index",
+     lambda m, x: m.unravel_index(
+         m.array(onp.array([5, 11, 19], onp.int32)), (4, 5))[1],
+     lambda x: onp.unravel_index(onp.array([5, 11, 19]), (4, 5))[1]),
+    ("iscomplex", lambda m, x: m.iscomplex(m.array(x)),
+     lambda x: onp.iscomplex(x)),
+    ("isreal", lambda m, x: m.isreal(m.array(x)),
+     lambda x: onp.isreal(x)),
+    ("isneginf",
+     lambda m, x: m.isneginf(m.array(
+         onp.array([-onp.inf, 1.0, onp.inf], onp.float32))),
+     lambda x: onp.isneginf(onp.array([-onp.inf, 1.0, onp.inf],
+                                      onp.float32))),
+    ("isposinf",
+     lambda m, x: m.isposinf(m.array(
+         onp.array([-onp.inf, 1.0, onp.inf], onp.float32))),
+     lambda x: onp.isposinf(onp.array([-onp.inf, 1.0, onp.inf],
+                                      onp.float32))),
+    ("logaddexp2",
+     lambda m, x: m.logaddexp2(m.array(x), m.array(x + 1.0)),
+     lambda x: onp.logaddexp2(x, x + 1.0)),
+    ("nancumprod",
+     lambda m, x: m.nancumprod(m.array(_xnan()[:2] * 0.5), axis=1),
+     lambda x: onp.nancumprod(_xnan()[:2] * 0.5, axis=1)),
+    ("rollaxis", lambda m, x: m.rollaxis(m.array(x), 1, 0),
+     lambda x: onp.rollaxis(x, 1, 0)),
+    ("take",
+     lambda m, x: m.take(m.array(x),
+                         m.array(onp.array([3, 0, 2], onp.int32)),
+                         axis=1),
+     lambda x: onp.take(x, onp.array([3, 0, 2]), axis=1)),
+]
+
+
+@pytest.mark.parametrize("case", EXT_FNS4, ids=[c[0] for c in EXT_FNS4])
+def test_np_extended_surface_round4(case):
+    name, mx_fn, onp_fn = case
+    if not hasattr(np, name):
+        pytest.skip(f"mx.np.{name} absent")
+    x = _r((4, 5), 41)
+    got = mx_fn(np, x)
+    got = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    want = onp.asarray(onp_fn(x))
+    assert got.shape == want.shape, \
+        f"{name}: shape {got.shape} vs numpy {want.shape}"
+    if want.dtype.kind == "b":
+        assert onp.dtype(got.dtype).kind == "b", \
+            f"{name}: bool result came back as {got.dtype}"
+        onp.testing.assert_array_equal(got, want)
+    elif want.dtype.kind in "iu":
+        assert onp.dtype(got.dtype).kind in "iu", \
+            f"{name}: integer result came back as {got.dtype}"
+        onp.testing.assert_array_equal(got, want)
+    else:
+        onp.testing.assert_allclose(onp.asarray(got, want.dtype), want,
+                                    rtol=2e-5, atol=2e-6)
+
+
 def test_np_dtype_introspection_helpers():
     """result_type / promote_types / can_cast answer with the x64-less
     lattice where it AGREES with numpy (the divergent int32+f32 case is

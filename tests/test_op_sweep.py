@@ -295,6 +295,79 @@ def _sweep_override(name):
              nd.array(np.array([[0, 1, 2, 0], [2, 1, 0, 1]], np.float32)),
              nd.array(np.array([4, 3], np.float32)),
              nd.array(np.array([5.0, 5.0], np.float32))], {}),
+        # ISSUE 13 satellite burn-down: the aux-state norm ops, RNN, the
+        # loss-head Softmax alias, offset/int8 convolutions, the fused
+        # mp-sgd multi-tensor pair, and the fused masked-attention family
+        # now run the real forward sweep on structured inputs.
+        # BatchNorm contract: (data NCHW, gamma, beta, moving_mean,
+        # moving_var) — train mode normalizes with BATCH stats, the
+        # moving inputs are state
+        "BatchNorm": lambda: (
+            [nd.array(r.randn(2, 3, 4, 4).astype(np.float32)),
+             nd.array((np.abs(r.rand(3)) + 0.5).astype(np.float32)),
+             nd.array((r.randn(3) * 0.1).astype(np.float32)),
+             nd.array(np.zeros(3, np.float32)),
+             nd.array(np.ones(3, np.float32))], {}),
+        "BatchNormWithReLU": lambda: (
+            [nd.array(r.randn(2, 3, 4, 4).astype(np.float32)),
+             nd.array((np.abs(r.rand(3)) + 0.5).astype(np.float32)),
+             nd.array((r.randn(3) * 0.1).astype(np.float32)),
+             nd.array(np.zeros(3, np.float32)),
+             nd.array(np.ones(3, np.float32))], {}),
+        # RNN: time-major (L, B, I) data, packed params, (layers, B, H)
+        # initial state; single-layer rnn_tanh keeps the packing tiny
+        "RNN": lambda: (
+            [nd.array(r.randn(4, 2, 3).astype(np.float32)),
+             nd.array((r.randn(5 * (3 + 5 + 2)) * 0.1)
+                      .astype(np.float32)),
+             nd.array(np.zeros((1, 2, 5), np.float32))],
+            {"state_size": 5, "num_layers": 1, "mode": "rnn_tanh"}),
+        # Softmax (capital) is the upstream SoftmaxOutput loss-head
+        # alias: (data, label)
+        "Softmax": lambda: ([x, lab], {}),
+        # deformable conv: (data, offset (2*k*k ch), weight, bias)
+        "contrib.DeformableConvolution": lambda: (
+            [nd.array(r.randn(1, 2, 6, 6).astype(np.float32)),
+             nd.array((r.randn(1, 18, 6, 6) * 0.1).astype(np.float32)),
+             nd.array(r.randn(3, 2, 3, 3).astype(np.float32)),
+             nd.array(np.zeros(3, np.float32))],
+            {"kernel": (3, 3), "num_filter": 3, "pad": (1, 1)}),
+        # int8 NCHW conv + range scalars (the quantized_dot recipe)
+        "contrib.quantized_conv": lambda: (
+            [nd.array(np.array(r.randint(-127, 128, (1, 2, 6, 6)),
+                               np.int8), dtype="int8"),
+             nd.array(np.array(r.randint(-127, 128, (3, 2, 3, 3)),
+                               np.int8), dtype="int8"),
+             nd.array(np.array([-1.0], np.float32)),
+             nd.array(np.array([1.0], np.float32)),
+             nd.array(np.array([-2.0], np.float32)),
+             nd.array(np.array([2.0], np.float32))], {"pad": (1, 1)}),
+        # fused mp-sgd: (w, g, w32)*K [+ m for mom] then lrs, wds arrays
+        "multi_mp_sgd_update": lambda: (
+            [w, g, w.astype("float32"),
+             nd.array(np.array([0.01], np.float32)),
+             nd.array(np.array([0.0], np.float32))],
+            {"num_weights": 1}),
+        "multi_mp_sgd_mom_update": lambda: (
+            [w, g, z(), w.astype("float32"),
+             nd.array(np.array([0.01], np.float32)),
+             nd.array(np.array([0.0], np.float32))],
+            {"num_weights": 1}),
+        # masked attention family (dense fallback path off-TPU):
+        # selfatt keeps the reference interleaved (L, B, 3*H*D) layout
+        "contrib.masked_selfatt": lambda: (
+            [nd.array(r.randn(4, 2, 24).astype(np.float32))],
+            {"heads": 2}),
+        # qkv entry: separate (B, H, L, D) tensors
+        "contrib.masked_att_qkv": lambda: (
+            [nd.array(r.randn(2, 2, 4, 8).astype(np.float32)),
+             nd.array(r.randn(2, 2, 4, 8).astype(np.float32)),
+             nd.array(r.randn(2, 2, 4, 8).astype(np.float32))], {}),
+        # encdec: q (Lq, B, H*D), kv (Lk, B, 2*H*D) interleaved k/v
+        "contrib.masked_encdec_att": lambda: (
+            [nd.array(r.randn(4, 2, 8).astype(np.float32)),
+             nd.array(r.randn(5, 2, 16).astype(np.float32))],
+            {"heads": 2}),
     }
     _OVERRIDE_KEYS = frozenset(table)
     if name is None:
@@ -304,33 +377,16 @@ def _sweep_override(name):
 
 
 # ops the generic synthesizer cannot drive, with the reason (tier-1 skip
-# list — the meta-test asserts this list only names real registry ops)
+# list — the meta-test asserts this list only names real registry ops).
+# ISSUE 13 satellite burn-down emptied the list down to the one
+# genuinely mesh-dependent entry: BatchNorm(WithReLU), RNN, Softmax (the
+# loss-head alias), DeformableConvolution, quantized_conv, the fused
+# multi_mp_sgd pair, and the masked-attention family all run the real
+# forward sweep via _sweep_override now.
 SYNTH_SKIP = {
-    "RNN": "stateful multi-input op; covered by tests/test_gluon_rnn.py",
-    "BatchNorm": "aux-state op; covered by test_operator/test_gluon",
-    "BatchNormWithReLU": "aux-state op (same contract as BatchNorm); "
-                         "covered by test_operator r5 additions",
-    "Softmax": "upstream alias of the SoftmaxOutput LOSS head (label "
-               "contract); softmax (lowercase) is the activation",
-    # fused attention kernels still skipped: flash/Pallas toolchain paths
-    # and mesh-dependent SP entries with dedicated parity tests (the
-    # dense interleaved_matmul_* family now sweeps via _sweep_override —
-    # ISSUE 12 satellite burn-down)
-    "contrib.masked_selfatt": "test_flash_attention + test_tpu_smoke",
-    "contrib.masked_att_qkv": "test_flash_attention + test_llama",
-    "contrib.masked_encdec_att": "test_model_zoo transformer tests",
-    "contrib.sp_att_qkv": "mesh-dependent; test_ring_attention/test_ulysses",
-    # remaining vision skip: offset-conv needs a learned-offset contract
-    "contrib.DeformableConvolution": "offset inputs; test_vision_ops",
-    # remaining quantization skip: layout/calibration of conv kernels
-    "contrib.quantized_conv": "test_quantization",
-    # fused multi-tensor optimizer kernels: variadic (w, g, state...)*K
-    # flat-list contract; exercised end-to-end by test_multi_optimizer.
-    # The whole single-param family (adadelta/adagrad/rmsprop/signum/
-    # nag/ftrl and — ISSUE 11 satellite — adamw/rmspropalex/lars/lamb)
-    # now runs the real sweep via _sweep_override.
-    "multi_mp_sgd_update": "fused multi-tensor; test_multi_optimizer",
-    "multi_mp_sgd_mom_update": "fused multi-tensor; test_multi_optimizer",
+    "contrib.sp_att_qkv": "mesh-dependent (resolves parallel.current_"
+                          "mesh() at call time); parity-tested by "
+                          "test_ring_attention/test_ulysses",
 }
 
 
@@ -527,6 +583,30 @@ FD_SKIP = {
                          "the state output rides a scan; backward is "
                          "covered by the LL head's analytic grad in "
                          "test_contrib_ops",
+    # ISSUE 13 satellite burn-down: forward now swept; backward exempt
+    # with the honest reason per entry
+    "Softmax": "loss head (SoftmaxOutput alias): backward = softmax - "
+               "label by contract, not d(forward)/dx",
+    "BatchNormWithReLU": "relu kink at 0 on top of the normalization",
+    "multi_mp_sgd_update": "optimizer update",
+    "multi_mp_sgd_mom_update": "optimizer update",
+    "contrib.DeformableConvolution": "bilinear sampling grid kinks "
+                                     "(BilinearSampler class) in the "
+                                     "offset path",
+    "contrib.quantized_conv": "int8 operands; range inputs kink at "
+                              "|min|==|max| (max-of-abs)",
+    "BatchNorm": "batch-stat normalization runs float32 on the x64-less "
+                 "lattice; 1e-5-eps FD loses precision (backward "
+                 "covered by test_operator/test_gluon BatchNorm tests)",
+    "contrib.masked_selfatt": "softmax core float32 on the x64-less "
+                              "lattice (float64 FD precision lost, the "
+                              "contrib.fft class); grads parity-tested "
+                              "by test_flash_attention",
+    "contrib.masked_att_qkv": "float32 softmax core (same class as "
+                              "masked_selfatt); test_flash_attention",
+    "contrib.masked_encdec_att": "float32 softmax core (same class as "
+                                 "masked_selfatt); transformer grads in "
+                                 "test_model_zoo",
 }
 
 

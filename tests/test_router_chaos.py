@@ -1,0 +1,150 @@
+"""Router-tier chaos e2e (ISSUE 13 acceptance; the router-chaos CI lane).
+
+Two REAL llama replicas under load, with BOTH control-plane deaths the
+tier is designed around induced in one run:
+
+- replica 0 is chaos-armed ``serving.reply:exit:1`` — it dies after
+  computing its first result but BEFORE acking it (the dedup-on-retry
+  window), which also strands its other in-flight requests mid-decode;
+- the router itself is chaos-killed at ``router.dispatch`` (exit after 3
+  dispatches) — requests journaled, some unsent, replicas mid-compute.
+
+A second driver run (``--resume``) re-adopts the live replica through
+its port file, respawns the corpse (which dies AGAIN on its first reply
+— the respawn budget then retires it), re-dispatches the journal, and
+submits what run 1 shed.  The test asserts the acceptance criteria:
+
+- every accepted request completes with output TOKEN-IDENTICAL to a
+  single uninterrupted engine (the in-process oracle below);
+- shed requests failed fast with RouterOverloaded (progress.log carries
+  sub-second shed timestamps from run 1) — they never hang;
+- the merged Chrome trace covers router + both replica lanes with the
+  retry/reply spans linked per rid, and the flight recorder holds the
+  postmortems of every induced death.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import llama
+from mxnet_tpu.telemetry import aggregate
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SEED, VOCAB, MAX_NEW = 7, 101, 6
+
+REPLICA_CMD = [sys.executable, "-m", "mxnet_tpu.serving.replica",
+               "--model", "llama_tiny", "--vocab", str(VOCAB),
+               "--seed", str(SEED), "--eos", "-1",
+               "--max-batch", "4", "--block-tokens", "4",
+               "--max-seq", "64", "--prefill-tokens", "16"]
+
+
+def _oracle_net():
+    mx.random.seed(SEED)
+    np.random.seed(SEED)
+    net = llama.llama_model("llama_tiny", vocab_size=VOCAB)
+    net.initialize(mx.initializer.Normal(0.05))
+    net(mx.nd.array(np.zeros((1, 4), np.int32)))
+    return net
+
+
+def _ref_greedy(net, prompt, max_new, pad_to=32):
+    buf = np.zeros((1, pad_to), np.int32)
+    buf[0, :len(prompt)] = prompt
+    n, out = len(prompt), []
+    for _ in range(max_new):
+        logits = net(mx.nd.array(buf)).asnumpy()
+        nxt = int(logits[0, n - 1].argmax())
+        out.append(nxt)
+        buf[0, n] = nxt
+        n += 1
+    return out
+
+
+@pytest.mark.slow
+def test_router_chaos_e2e(tmp_path):
+    r = np.random.RandomState(5)
+    reqs = [{"tag": f"t{i}",
+             "prompt": [int(t) for t in
+                        r.randint(3, VOCAB, r.randint(3, 9))],
+             "max_new_tokens": MAX_NEW}
+            for i in range(8)]
+    net = _oracle_net()
+    oracle = {rec["tag"]: _ref_greedy(net, rec["prompt"], MAX_NEW)
+              for rec in reqs}
+
+    req_file = tmp_path / "reqs.json"
+    req_file.write_text(json.dumps(reqs))
+    out_file = tmp_path / "out.json"
+    base = [sys.executable, os.path.join(HERE, "_router_driver.py"),
+            "--workdir", str(tmp_path), "-n", "2",
+            "--requests", str(req_file), "--out", str(out_file),
+            "--replica-cmd", json.dumps(REPLICA_CMD),
+            "--replica-env", json.dumps(
+                {"0": {"MXNET_CHAOS": "1",
+                       "MXNET_CHAOS_SITES": "serving.reply:exit:1"}}),
+            "--max-respawns", "1", "--result-timeout", "200"]
+
+    # run 1: 5 accepted (3 shed fast), router chaos-killed on dispatch 4
+    p1 = subprocess.run(base + ["--queue-max", "5",
+                                "--dispatch-exit-after", "3",
+                                "--keep-replicas"],
+                        timeout=300, capture_output=True)
+    assert p1.returncode != 0, p1.stdout
+    assert not out_file.exists()
+    progress = (tmp_path / "progress.log").read_text().splitlines()
+    sheds = [ln.split() for ln in progress if ln.startswith("shed ")]
+    assert len(sheds) == 3, progress
+    assert all(float(s[2]) < 2.0 for s in sheds), \
+        f"shed must fail fast, not hang: {sheds}"
+    st = json.loads((tmp_path / "router.json").read_text())
+    assert st["phase"] == "running" and len(st["requests"]) == 5
+
+    # run 2: re-adopt, respawn, retry, finish everything
+    p2 = subprocess.run(base + ["--queue-max", "32", "--resume"],
+                        timeout=420, capture_output=True)
+    assert p2.returncode == 0, (p2.stdout, p2.stderr)
+    out = json.loads(out_file.read_text())
+
+    # every accepted request: token-identical to the uninterrupted engine
+    for rec in reqs:
+        got = out["results"][rec["tag"]]
+        assert got.get("tokens") == oracle[rec["tag"]], \
+            (rec["tag"], got, oracle[rec["tag"]])
+    assert out["counters"]["mxnet_router_retries_total"] >= 1
+    assert out["counters"]["mxnet_router_replica_deaths_total"] >= 1
+    assert out["counters"]["mxnet_router_respawns_total"] >= 1
+
+    # merged cross-process trace: router + both replica lanes, with the
+    # request/retry/reply spans linked per rid
+    snaps = aggregate.load_snapshots(str(tmp_path / "telemetry"))
+    ranks = {s.get("rank") for s in snaps}
+    assert {0, 1, 2} <= ranks, ranks      # replicas 0/1 + router (=2)
+    trace = aggregate.merged_chrome_trace(snaps)
+    evs = [e for e in trace["traceEvents"]
+           if e.get("cat") == "router.request"]
+    begins = {e["id"] for e in evs if e.get("ph") == "b"}
+    retries = {e["id"] for e in evs if e.get("name") == "retry"}
+    replies = {e["id"] for e in evs if e.get("name") == "replica_reply"}
+    assert retries and retries <= begins | retries
+    assert replies & begins, "replica reply markers must link router rids"
+    assert len({e.get("pid") for e in evs}) >= 2, \
+        "router.request spans must span router AND replica lanes"
+
+    # flight recorder: postmortems for the induced deaths (router chaos
+    # exit + replica serving.reply exits)
+    dumps = [fn for fn in os.listdir(tmp_path / "flightrec")
+             if fn.startswith("flightrec-") and fn.endswith(".json")]
+    assert len(dumps) >= 2, dumps
+    reasons = set()
+    for fn in dumps:
+        with open(tmp_path / "flightrec" / fn) as f:
+            reasons.add(json.load(f).get("reason"))
+    assert any("router.dispatch" in (r or "") for r in reasons), reasons
+    assert any("serving.reply" in (r or "") for r in reasons), reasons

@@ -287,6 +287,67 @@ def test_static_policy_matches_tokens(llama_net):
     assert cont == stat
 
 
+# -- engine hardening (ISSUE 13 satellite) ----------------------------------
+
+def test_engine_load_atomic_triple(llama_net):
+    """load() returns one consistent (queue_depth, active_slots,
+    free_blocks) snapshot under the scheduler lock — the replica-ack /
+    least-loaded dispatch signal."""
+    eng = _llama_engine(llama_net)
+    total_free = eng.cache.allocator.num_blocks - 1
+    assert eng.load() == (0, 0, total_free)
+    assert eng.free_slots == eng.max_batch
+    h = eng.submit([5, 6], max_new_tokens=4)
+    assert eng.load() == (1, 0, total_free)      # queued, nothing admitted
+    eng.drain()
+    assert h.result(timeout=5)
+    assert eng.load() == (0, 0, total_free)
+
+
+def test_submit_blown_deadline_fails_at_submit(llama_net):
+    """A non-positive remaining budget (a router forwarding an already
+    blown deadline) fails the handle at submit — no queue round-trip,
+    no prefill."""
+    eng = _llama_engine(llama_net)
+    p0 = telemetry.counter("mxnet_serving_prefills_total").value
+    h = eng.submit([5, 6, 7], max_new_tokens=4, deadline_s=-0.5)
+    assert h.ready()
+    with pytest.raises(serving.RequestDeadlineExceeded):
+        h.result(timeout=5)
+    assert telemetry.counter(
+        "mxnet_serving_prefills_total").value == p0
+
+
+def test_deadline_lapsing_during_admission_skips_prefill(llama_net):
+    """A request whose deadline lapses while EARLIER admissions in the
+    same scheduler iteration burn prefills is evicted at its own
+    admission turn — it must not pay a prefill first."""
+    eng = _llama_engine(llama_net)
+    orig_prefill = eng.adapter.prefill
+    calls = []
+
+    def slow_prefill(slot, prompt, table_row):
+        calls.append(slot)
+        import time as _t
+        _t.sleep(0.08)
+        return orig_prefill(slot, prompt, table_row)
+
+    eng.adapter.prefill = slow_prefill
+    try:
+        ha = eng.submit([5, 6], max_new_tokens=2)           # admits first
+        hb = eng.submit([7, 8], max_new_tokens=2, deadline_s=0.03)
+        p0 = telemetry.counter("mxnet_serving_prefills_total").value
+        eng.step()      # admits A (80ms prefill) -> B's deadline lapses
+        with pytest.raises(serving.RequestDeadlineExceeded):
+            hb.result(timeout=5)
+        assert telemetry.counter(
+            "mxnet_serving_prefills_total").value == p0 + 1
+        eng.drain()
+        assert ha.result(timeout=5)
+    finally:
+        eng.adapter.prefill = orig_prefill
+
+
 # -- transformer (encoder-decoder) ------------------------------------------
 
 def test_transformer_paged_decode_token_identical(tf_net):

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Serving-tier launcher (ISSUE 13) — the CLI over
+``mxnet_tpu.serving.Router``.
+
+Brings up a router over N llama engine replicas, pushes a prompt file
+through the tier, prints one JSON line per result, and shuts the tier
+down.  Rerunning with ``--resume`` on the same ``--workdir`` re-adopts a
+dead router's live replicas (state journal + replica port files) and
+finishes its journaled in-flight requests first.
+
+Usage:
+  python tools/serve_router.py -n 2 --workdir /tmp/tier \\
+      --model llama_tiny --vocab 101 --seed 7 \\
+      --prompts prompts.json [--queue-max 64 --hedge-s 0.05] [--resume]
+
+``prompts.json`` is a JSON list of ``{"prompt": [ints],
+"max_new_tokens": N[, "deadline_s": s][, "tag": str]}``.  Without
+``--prompts`` the CLI just proves the tier comes up and prints its
+health view.  ``--keep`` leaves the replicas running at exit (a later
+``--resume`` run re-adopts them).  Exit code 0 = every submitted
+request completed; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fault-tolerant serving tier: router over N engine "
+                    "replica subprocesses")
+    ap.add_argument("-n", "--replicas", type=int, default=2)
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--model", default="llama_tiny")
+    ap.add_argument("--vocab", type=int, default=101)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--eos", type=int, default=-1)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--block-tokens", type=int, default=None)
+    ap.add_argument("--max-seq", type=int, default=None)
+    ap.add_argument("--prefill-tokens", type=int, default=None)
+    ap.add_argument("--replica-cmd", default=None,
+                    help="override the replica argv (JSON list); "
+                         "default builds the llama worker from the "
+                         "--model/--vocab/--seed flags")
+    ap.add_argument("--prompts", default=None,
+                    help="JSON request file (see module docstring)")
+    ap.add_argument("--queue-max", type=int, default=None)
+    ap.add_argument("--hedge-s", type=float, default=None)
+    ap.add_argument("--max-retries", type=int, default=None)
+    ap.add_argument("--max-respawns", type=int, default=None)
+    ap.add_argument("--hang-s", type=float, default=None)
+    ap.add_argument("--resume", action="store_true",
+                    help="(re-)run on an existing workdir: re-adopt "
+                         "live replicas and finish the journal")
+    ap.add_argument("--keep", action="store_true",
+                    help="leave replicas running at exit")
+    ap.add_argument("--result-timeout", type=float, default=300.0)
+    args = ap.parse_args(argv)
+
+    workdir = os.path.abspath(args.workdir)
+    os.environ["MXNET_TELEMETRY"] = "1"
+    os.environ["MXNET_TELEMETRY_DIR"] = os.path.join(workdir, "telemetry")
+    os.environ["MXNET_FLIGHTREC_DIR"] = os.path.join(workdir, "flightrec")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu.serving.router import Router, RouterOverloaded
+
+    if args.replica_cmd:
+        cmd = json.loads(args.replica_cmd)
+    else:
+        cmd = [sys.executable, "-m", "mxnet_tpu.serving.replica",
+               "--model", args.model, "--vocab", str(args.vocab),
+               "--seed", str(args.seed), "--eos", str(args.eos)]
+        for flag, val in (("--max-batch", args.max_batch),
+                          ("--block-tokens", args.block_tokens),
+                          ("--max-seq", args.max_seq),
+                          ("--prefill-tokens", args.prefill_tokens)):
+            if val is not None:
+                cmd += [flag, str(val)]
+
+    router = Router(cmd, args.replicas, workdir,
+                    queue_max=args.queue_max, hedge_s=args.hedge_s,
+                    max_retries=args.max_retries,
+                    max_respawns=args.max_respawns,
+                    hang_s=args.hang_s).start()
+    failed = 0
+    try:
+        up = router.wait_up(timeout_s=300)
+        print(json.dumps({"event": "tier_up", "replicas_up": up,
+                          "status": router.replica_status()}))
+        handles = dict(router.recovered()) if args.resume else {}
+        if args.prompts:
+            with open(args.prompts) as f:
+                want = json.load(f)
+            for i, rec in enumerate(want):
+                tag = rec.get("tag", f"req-{i}")
+                if tag in handles:
+                    continue
+                try:
+                    handles[tag] = router.submit(
+                        rec["prompt"], rec.get("max_new_tokens", 32),
+                        deadline_s=rec.get("deadline_s"), tag=tag)
+                except RouterOverloaded as exc:
+                    failed += 1
+                    print(json.dumps({"tag": tag, "error":
+                                      "RouterOverloaded",
+                                      "message": str(exc)[:120]}))
+        for tag, h in handles.items():
+            try:
+                print(json.dumps({
+                    "tag": tag,
+                    "tokens": h.result(timeout=args.result_timeout),
+                    "stats": h.stats()}))
+            except Exception as exc:  # noqa: BLE001 — reported per request
+                failed += 1
+                print(json.dumps({"tag": tag,
+                                  "error": type(exc).__name__,
+                                  "message": str(exc)[:200]}))
+    finally:
+        router.stop(shutdown_replicas=not args.keep)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
