@@ -82,7 +82,7 @@ def __getattr__(name):
               "monitor", "checkpoint", "dmlc_params", "operator",
               "pipeline", "name", "attribute", "rtc", "native",
               "visualization", "library", "telemetry", "resilience",
-              "analysis", "serving"}
+              "analysis", "serving", "autoshard"}
     if name in lazies:
         mod = _lazy(name)
         globals()[name] = mod

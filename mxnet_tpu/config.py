@@ -205,6 +205,30 @@ KNOWN_VARS = {
         "(a mesh-jitted step already psum'd their grads — reducing again "
         "would double-count); dist stores always reduce. 0 restores the "
         "unconditional reduction."),
+    # auto-sharder / memory-axis scale (ISSUE 14: mxnet_tpu.autoshard)
+    "MXNET_MICROBATCH": (
+        "1", int,
+        "Trace-time default for parallel.TrainStep(n_micro=): gradient-"
+        "accumulation microbatch count per step (the batch splits into "
+        "this many slices scanned with fixed-association accumulation "
+        "and ONE optimizer update). 1 (default) keeps the original "
+        "single-pass step, bit-identically."),
+    "MXNET_REMAT": (
+        "0", int,
+        "Trace-time default for parallel.TrainStep(remat=): if 1, the "
+        "net forward runs under gluon.utils.remat_call so activations "
+        "are recomputed during backward instead of saved (memory for "
+        "compute; single-output nets only)."),
+    "MXNET_AUTOSHARD_HBM_GB": (
+        "0", float,
+        "Default per-device HBM budget (GB) for autoshard.plan() and "
+        "tools/autoshard.py when the caller passes none; 0 (default) "
+        "means unbounded — the planner ranks purely on speed."),
+    "MXNET_AUTOSHARD_MAX_MICRO": (
+        "8", int,
+        "Largest microbatch count the auto-sharder may propose while "
+        "searching for a fitting layout (candidates double from 1 up "
+        "to this bound)."),
     # resilience family (ISSUE 3: mx.resilience)
     "MXNET_KVSTORE_TIMEOUT_S": (
         "300", float,

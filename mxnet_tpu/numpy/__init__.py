@@ -204,6 +204,16 @@ def _populate():
 
     histogram.__doc__ = _hist_raw.__doc__
     _self.histogram = histogram
+    # jnp.shape returns a plain tuple of python ints — routing it
+    # through the registry delegation would try to rebuild that tuple
+    # as op outputs (ISSUE 14 round-5 catch); bind the introspection
+    # helper host-side like numpy's
+
+    def shape(a):
+        return tuple(a.shape) if hasattr(a, "shape") else jnp.shape(a)
+
+    shape.__doc__ = jnp.shape.__doc__
+    _self.shape = shape
     # subnamespaces
     lin = _types.ModuleType(__name__ + ".linalg")
     import jax.numpy.linalg as jla

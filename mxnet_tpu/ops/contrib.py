@@ -317,6 +317,105 @@ def _sp_att_qkv(q, k, v, impl="ring", axis="sp", num_kv_groups=1,
     return jax.device_put(out, home) if eager else out
 
 
+# ---------------------------------------------------------------------------
+# multihead_attention_* named wrappers (VERDICT missing #2 / ISSUE 14
+# satellite): the reference registers mha-named variants of the fused
+# attention family alongside the interleaved_matmul ops (SURVEY §2.2
+# contrib/ row).  These wrap the SAME cores as the interleaved/masked
+# family — `_attend` / `_dense_sdpa` — so there is exactly one attention
+# numerics implementation in the tree (the PR-6 no-drift discipline);
+# parity against `_dense_sdpa` is pinned by tests/test_contrib_ops.py.
+# Layout: SEPARATE (non-interleaved) time-major projections, the shape
+# GluonNLP's modular AttentionCell emits — q (Lq, B, heads*D),
+# k/v (Lk, B, heads*D).
+# ---------------------------------------------------------------------------
+
+def _split_heads(x, heads):
+    """(L, B, H*D) -> (B, H, L, D)."""
+    jnp = _jnp()
+    L, B, E = x.shape
+    return jnp.transpose(x.reshape(L, B, heads, E // heads), (1, 2, 0, 3))
+
+
+def _merge_heads(x):
+    """(B, H, L, D) -> (L, B, H*D)."""
+    jnp = _jnp()
+    B, H, L, D = x.shape
+    return jnp.transpose(x, (2, 0, 1, 3)).reshape(L, B, H * D)
+
+
+@register("contrib.multihead_attention_qk")
+def _multihead_attention_qk(q, k, heads=1):
+    """Scaled attention scores from separate projections: q (Lq, B,
+    heads*D) × k (Lk, B, heads*D) -> (B*heads, Lq, Lk) — the reference
+    score layout the interleaved qk ops also emit."""
+    jnp = _jnp()
+    qh = _split_heads(q, heads)
+    kh = _split_heads(k, heads)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(qh.shape[-1], q.dtype))
+    att = jnp.einsum("bhqd,bhkd->bhqk", qh * scale, kh)
+    return att.reshape(-1, q.shape[0], k.shape[0])
+
+
+@register("contrib.multihead_attention_valatt")
+def _multihead_attention_valatt(att, v, heads=1):
+    """Apply (B*heads, Lq, Lk) attention weights to v (Lk, B, heads*D)
+    -> (Lq, B, heads*D)."""
+    jnp = _jnp()
+    vh = _split_heads(v, heads)
+    B = v.shape[1]
+    a = att.reshape(B, heads, att.shape[1], att.shape[2])
+    return _merge_heads(jnp.einsum("bhqk,bhkd->bhqd", a, vh))
+
+
+@register("contrib.multihead_attention")
+def _multihead_attention(q, k, v, valid_length=None, heads=1,
+                         causal=False):
+    """Fused masked multi-head attention over separate time-major
+    projections — the single-op form of the qk → (mask) → softmax →
+    valatt chain above, numerically `_dense_sdpa` (fp32 softmax; the
+    Pallas flash kernel on TPU via the shared `_attend` core).
+
+    ``valid_length`` (B,) masks KEY positions >= the length — queries
+    are always valid (the cross-attention convention; target-side
+    padding is the loss's job), and the semantics do NOT depend on
+    whether Lq happens to equal Lk.  ``causal`` requires Lq == Lk (a
+    causal mask over unequal lengths has no defined alignment here) and
+    composes with ``valid_length``."""
+    from ..base import MXNetError
+    jnp = _jnp()
+    if causal and q.shape[0] != k.shape[0]:
+        raise MXNetError(
+            "contrib.multihead_attention: causal=True needs Lq == Lk "
+            f"(got {q.shape[0]} vs {k.shape[0]}) — causal alignment "
+            "over unequal lengths is undefined")
+    qh = _split_heads(q, heads)
+    kh = _split_heads(k, heads)
+    vh = _split_heads(v, heads)
+    if valid_length is None and q.shape[0] == k.shape[0]:
+        # mask-free self-length: the flash-capable core (causal rides
+        # the kernel).  Cross lengths stay OFF this path — _attend's
+        # flash gate checks only Lq, and an unaligned Lk would hand the
+        # Pallas kernel a non-lane-aligned k/v tile.
+        out = _attend(qh, kh, vh, None, causal)
+    elif valid_length is None:
+        scale = 1.0 / float(qh.shape[-1]) ** 0.5
+        out = _dense_sdpa_cross(qh, kh, vh, None, scale)
+    else:
+        # key-side-only masking — _attend's symmetric segment mask
+        # would also pad QUERY positions >= valid_length, which is the
+        # self-attention contract (masked_selfatt), not this op's
+        Lk = k.shape[0]
+        steps = jnp.arange(Lk, dtype=jnp.int32)
+        seg_kv = (steps[None, :]
+                  < valid_length.astype(jnp.int32)[:, None]) \
+            .astype(jnp.int32)
+        scale = 1.0 / float(qh.shape[-1]) ** 0.5
+        out = _dense_sdpa_cross(qh, kh, vh, seg_kv, scale,
+                                causal=causal)
+    return _merge_heads(out)
+
+
 @register("contrib.interleaved_matmul_encdec_qk")
 def _interleaved_matmul_encdec_qk(q, kv, heads=1):
     jnp = _jnp()
@@ -397,15 +496,20 @@ def _masked_encdec_att(q, kv, valid_length=None, heads=1):
     return jnp.transpose(out, (2, 0, 1, 3)).reshape(Lq, B, E)
 
 
-def _dense_sdpa_cross(q, k, v, seg_kv, scale):
+def _dense_sdpa_cross(q, k, v, seg_kv, scale, causal=False):
     """Cross-attention dense fallback: only KEY positions are masked
-    (seg_kv (B, Lk); None = all valid), fp32 softmax."""
+    (seg_kv (B, Lk); None = all valid), fp32 softmax.  ``causal``
+    (callers guarantee Lq == Lk) adds the lower-triangular mask on
+    top — the key-only-masked causal path of multihead_attention."""
     import jax
     jnp = _jnp()
     att = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    neg = jnp.asarray(-1e9, jnp.float32)
     if seg_kv is not None:
-        att = jnp.where((seg_kv > 0)[:, None, None, :], att,
-                        jnp.asarray(-1e9, jnp.float32))
+        att = jnp.where((seg_kv > 0)[:, None, None, :], att, neg)
+    if causal:
+        cm = jnp.tril(jnp.ones((att.shape[-2], att.shape[-1]), bool))
+        att = jnp.where(cm[None, None], att, neg)
     p = jax.nn.softmax(att, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 

@@ -50,6 +50,10 @@ _M_RETRACES = _tel.counter(
     "mxnet_sharding_retraces_total",
     "TrainStep executable builds (trace+compile); growth at steady state "
     "is a retrace bug — see graftcheck GC02.")
+_M_MICROBATCHES = _tel.counter(
+    "mxnet_trainstep_microbatches_total",
+    "Microbatches executed by gradient-accumulation TrainSteps "
+    "(n_micro per dispatch; n_micro=1 steps do not count).")
 
 __all__ = ["DeviceMesh", "make_mesh", "data_parallel_ctxs", "TrainStep",
            "allreduce", "allgather", "current_mesh", "set_mesh",
@@ -371,6 +375,16 @@ class TrainStep:
     dim (default ``('dp',)``): e.g. ``('dp', 'sp')`` shards (B, L) token
     batches over data AND sequence axes — the dp×tp×sp 3-axis recipe.
 
+    Memory-axis knobs (ISSUE 14): ``n_micro`` runs the step as
+    gradient-accumulation microbatching (scan over B/n_micro slices,
+    fixed-association accumulation, ONE optimizer update; n_micro=1 is
+    the original single-pass trace, bit-identical), ``remat`` wraps the
+    net forward in ``gluon.utils.remat_call`` (activations recomputed in
+    backward; single-output nets only), and ``plan`` consumes an
+    ``autoshard.Plan`` (mesh + rule pack + data_spec + n_micro + remat
+    as defaults).  Trace-time knob defaults: MXNET_MICROBATCH,
+    MXNET_REMAT.
+
     Equivalent reference machinery: CachedOp::Forward/Backward +
     Trainer.step + CommDevice reduce + fused optimizer kernels, all in one
     XLA program.
@@ -378,14 +392,38 @@ class TrainStep:
 
     def __init__(self, net, loss_fn, optimizer, optimizer_params=None,
                  mesh=None, donate=True, partition_rules=None,
-                 data_spec=None):
+                 data_spec=None, n_micro=None, remat=None, plan=None):
         from . import optimizer as opt
+        from . import config as _config
         self.net = net
         self.loss_fn = loss_fn
         if isinstance(optimizer, str):
             self.optimizer = opt.create(optimizer, **(optimizer_params or {}))
         else:
             self.optimizer = optimizer
+        if plan is not None:
+            # an autoshard Plan (mxnet_tpu.autoshard) is consumed
+            # directly: it supplies the mesh, the rule pack, the batch
+            # layout and the microbatch/remat policy — any explicit
+            # constructor argument still wins (plan as defaults)
+            if mesh is None:
+                mesh = plan.build_mesh()
+            if partition_rules is None:
+                partition_rules = plan.rules()
+            if data_spec is None:
+                data_spec = plan.data_spec
+            if n_micro is None:
+                n_micro = plan.n_micro
+            if remat is None:
+                remat = plan.remat
+        if n_micro is None:
+            n_micro = max(1, _config.get_int("MXNET_MICROBATCH", 1))
+        n_micro = int(n_micro)
+        if n_micro < 1:
+            raise MXNetError(f"n_micro must be >= 1, got {n_micro}")
+        self._n_micro = n_micro
+        self._remat = bool(_config.get_int("MXNET_REMAT", 0)) \
+            if remat is None else bool(remat)
         self.mesh = mesh or current_mesh() or make_mesh()
         self._donate = donate
         self._rules = partition_rules
@@ -527,7 +565,22 @@ class TrainStep:
     # -- trace ----------------------------------------------------------------
     def _make_raw(self):
         """The traced single-step body shared by _build (one step per call)
-        and _build_multi (lax.scan of many steps per call)."""
+        and _build_multi (lax.scan of many steps per call).
+
+        ``n_micro > 1`` turns the body into gradient-accumulation
+        microbatching: the batch reshapes to (n_micro, B/n_micro, ...) and
+        a lax.scan runs forward+backward per microbatch, accumulating
+        gradients in FIXED association (the scan's sequential carry —
+        micro 0 first, always), then applies ONE optimizer update with the
+        mean gradient.  The reported loss is the mean of per-microbatch
+        losses, which equals the full-batch objective for the per-sample-
+        mean losses every lane uses.  ``n_micro == 1`` takes the original
+        single-pass body — bit-identical to the pre-microbatching step by
+        construction (same trace, no scan, no accumulator).
+
+        ``remat`` wraps the net forward in ``gluon.utils.remat_call``:
+        activations inside the net are recomputed during backward instead
+        of saved (single-output nets only — remat_call's contract)."""
         from . import autograd, random as _rnd
 
         params, trainable = self._params, self._trainable
@@ -536,11 +589,44 @@ class TrainStep:
         loss_fn = self.loss_fn
         net = self.net
         fused = self._fused
+        n_micro = self._n_micro
+        remat = self._remat
         from . import optimizer_fusion as _fus
 
         from .ndarray.ndarray import swap_slot_values
 
+        def forward_loss(key, d, l):
+            """(remat'd) forward + loss under record scope; grads land in
+            the (pre-zeroed) grad slots."""
+            d_nd, l_nd = NDArray._from_data(d), NDArray._from_data(l)
+            scope = _rnd.trace_key_scope(key)
+            with scope, autograd._scope(recording=True, training=True):
+                if remat:
+                    from .gluon.utils import remat_call
+                    out = remat_call(net, d_nd)
+                else:
+                    out = net(d_nd)
+                loss = loss_fn(out, l_nd)
+                if loss.shape:
+                    loss = loss.mean()
+            autograd.backward([loss])
+            return loss
+
+        def apply_update():
+            if fused is not None:
+                # fused flat update: same segment math as the
+                # imperative donated executables, inlined into
+                # this trace (bitwise identical to the loop below)
+                _fus.traced_update(optzr, fused[0], fused[1],
+                                   trainable, self._states)
+            else:
+                for i, p in enumerate(trainable):
+                    optzr.update_multi_precision(i, p._data,
+                                                 p._data._grad,
+                                                 self._states[i])
+
         def raw(key, t, lr_vec, rescale, param_vals, state_vals, d, l):
+            import jax
             import jax.numpy as jnp
             saved_opt = (optzr._update_count, optzr._index_update_count,
                          optzr._get_lr, optzr.rescale_grad)
@@ -559,28 +645,53 @@ class TrainStep:
                     optzr._get_lr = lambda idx: lr_vec[idx]
                     optzr.rescale_grad = rescale
 
-                    d_nd, l_nd = NDArray._from_data(d), NDArray._from_data(l)
-                    scope = _rnd.trace_key_scope(key)
-                    with scope, autograd._scope(recording=True, training=True):
-                        out = net(d_nd)
-                        loss = loss_fn(out, l_nd)
-                        if loss.shape:
-                            loss = loss.mean()
-                    autograd.backward([loss])
-                    if fused is not None:
-                        # fused flat update: same segment math as the
-                        # imperative donated executables, inlined into
-                        # this trace (bitwise identical to the loop below)
-                        _fus.traced_update(optzr, fused[0], fused[1],
-                                           trainable, self._states)
-                    else:
-                        for i, p in enumerate(trainable):
-                            optzr.update_multi_precision(i, p._data,
-                                                         p._data._grad,
-                                                         self._states[i])
-                    new_p = tuple(p._data._slot.value for p in params)
-                    new_s = tuple(s._slot.value for s in state_nds)
-                    return new_p, new_s, loss._data
+                    if n_micro == 1:
+                        loss = forward_loss(key, d, l)
+                        apply_update()
+                        new_p = tuple(p._data._slot.value for p in params)
+                        new_s = tuple(s._slot.value for s in state_nds)
+                        return new_p, new_s, loss._data
+
+                    # microbatched: (B, ...) -> (n_micro, B/n_micro, ...)
+                    # keeping each microbatch on the declared data layout
+                    d_sh, l_sh = self._data_shardings(
+                        len(d.shape), len(l.shape), stacked=True)
+                    dm = jax.lax.with_sharding_constraint(
+                        d.reshape((n_micro, d.shape[0] // n_micro)
+                                  + d.shape[1:]), d_sh)
+                    lm = jax.lax.with_sharding_constraint(
+                        l.reshape((n_micro, l.shape[0] // n_micro)
+                                  + l.shape[1:]), l_sh)
+                    keys = jax.random.split(key, n_micro)
+                    grad_nds = [p._data._grad for p in trainable]
+
+                    def micro(acc, xs):
+                        k_i, dd, ll = xs
+                        # fresh zero grads per microbatch; the micro's
+                        # gradient is read before the swap restores
+                        with swap_slot_values(
+                                [(g, jnp.zeros(p.shape, g.dtype))
+                                 for g, p in zip(grad_nds, trainable)]):
+                            loss = forward_loss(k_i, dd, ll)
+                            g = tuple(gn._slot.value for gn in grad_nds)
+                        # fixed-association accumulation: acc + micro_i,
+                        # in scan order
+                        acc = tuple(a + gi for a, gi in zip(acc, g))
+                        return acc, loss._data
+
+                    zeros = tuple(
+                        jnp.zeros(p.shape, p._data._grad.dtype)
+                        for p in trainable)
+                    acc, losses = jax.lax.scan(micro, zeros,
+                                               (keys, dm, lm))
+                    inv = jnp.asarray(1.0 / n_micro, losses.dtype)
+                    mean_g = tuple(a * jnp.asarray(1.0 / n_micro, a.dtype)
+                                   for a in acc)
+                    with swap_slot_values(list(zip(grad_nds, mean_g))):
+                        apply_update()
+                        new_p = tuple(p._data._slot.value for p in params)
+                        new_s = tuple(s._slot.value for s in state_nds)
+                        return new_p, new_s, (losses.sum() * inv)
             finally:
                 (optzr._update_count, optzr._index_update_count,
                  optzr._get_lr, optzr.rescale_grad) = saved_opt
@@ -661,6 +772,11 @@ class TrainStep:
         stacked = steps is None
         if stacked:
             steps = data.shape[0]
+        b_dim = data.shape[1] if stacked else data.shape[0]
+        if b_dim % self._n_micro:
+            raise MXNetError(
+                f"batch {b_dim} is not divisible by n_micro="
+                f"{self._n_micro}")
         if self._params is None:
             probe = NDArray._from_data(data._data[0]) if stacked else data
             self._resolve(probe)
@@ -714,6 +830,8 @@ class TrainStep:
         if enabled:
             _sclock.STEP_CLOCK.note("h2d", _time.perf_counter() - _t0)
             _M_STEP_DISPATCHES.inc()
+            if self._n_micro > 1:
+                _M_MICROBATCHES.inc(self._n_micro * steps)
         new_p, new_s, losses = fn(keys, ts, lr_vecs, rescale, p_vals, s_vals,
                                   d, l)
         for p, v in zip(self._params, new_p):
@@ -732,6 +850,10 @@ class TrainStep:
             data = nd.array(data)
         if not isinstance(label, NDArray):
             label = nd.array(label)
+        if data.shape[0] % self._n_micro:
+            raise MXNetError(
+                f"batch {data.shape[0]} is not divisible by n_micro="
+                f"{self._n_micro}")
         if self._params is None:
             self._resolve(data)
 
@@ -777,6 +899,8 @@ class TrainStep:
         if enabled:
             _sclock.STEP_CLOCK.note("h2d", _time.perf_counter() - _t0)
             _M_STEP_DISPATCHES.inc()
+            if self._n_micro > 1:
+                _M_MICROBATCHES.inc(self._n_micro)
         new_p, new_s, loss = fn(key, t, lr_vec, rescale, p_vals, s_vals, d, l)
         for p, v in zip(self._params, new_p):
             p._data._set_data(v)

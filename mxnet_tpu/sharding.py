@@ -46,7 +46,9 @@ from .telemetry import tracer as _ttrace
 
 __all__ = ["LOGICAL_AXES", "match_partition_rules", "apply_rules",
            "resolve_spec", "rule_pack", "llama_rules", "bert_rules",
-           "transformer_rules", "DEFAULT_TAIL", "mark_mesh_reduced"]
+           "transformer_rules", "llama_fsdp_rules", "bert_fsdp_rules",
+           "transformer_fsdp_rules", "DEFAULT_TAIL", "FSDP_TAIL",
+           "mark_mesh_reduced"]
 
 # The logical-axis vocabulary rules may name.  Convention (the scaling
 # playbook): outermost axis = data parallel (DCN-friendly), inner axes =
@@ -302,16 +304,94 @@ def transformer_rules(tp="tp"):
     ] + DEFAULT_TAIL(tp)
 
 
+# --------------------------------------------------------------------------
+# fsdp (ZeRO-3) rule packs — ISSUE 14 tentpole layer 1
+# --------------------------------------------------------------------------
+#
+# fsdp shards PARAMETERS along the data axis (ZeRO-3 / GSPMD "fully
+# sharded" recipe): every matmul weight stores only 1/|fsdp| of its
+# elements per device and XLA inserts the all-gather right before use
+# (and the reduce-scatter on the gradient), so weight + adam-state + grad
+# memory divides by the fsdp axis size while the math stays the dense
+# math modulo collective reassociation.  Optimizer state rides the owner
+# param's layout exactly as with tp (TrainStep._shardings), so m/v shard
+# for free.  Composition contract with tp on the SAME mesh: the tp axis
+# keeps the megatron dim it owns and fsdp takes the OTHER matmul dim —
+# one rule set covers dp-only, +fsdp, and +tp+fsdp meshes because
+# resolve_spec degrades any axis the mesh doesn't carry.
+#
+# Norm scales and biases stay replicated (FSDP_TAIL): they are O(d) while
+# the win is the O(d^2) matmuls, and sharding them would make every
+# norm a gather for bytes that round to zero.
+
+def FSDP_TAIL(fsdp="fsdp", tp="tp"):
+    """Embedding / norm / bias tail for the fsdp packs: embedding tables
+    shard vocab over tp AND fsdp (both dims huge), norms/biases
+    replicate."""
+    return [
+        (r"(tok|word|embed)[a-z0-9]*_weight$", ((tp, fsdp), None)),
+        (r"(gamma|beta)$", ()),
+        (r"norm_weight$", ()),
+        (r"_bias$", ()),
+    ]
+
+
+def llama_fsdp_rules(fsdp="fsdp", tp="tp"):
+    """ZeRO-3 layout for the llama GQA decoder, composable with tp.
+
+    Column-parallel weights (out, in) keep tp on dim0 and shard dim1
+    over fsdp; row-parallel (o/down) the mirror.  On a mesh without tp
+    the specs degrade to pure fsdp sharding; without fsdp they degrade
+    to llama_rules' tp layout; with neither, full replication — the
+    one-rule-set-per-model contract."""
+    return [
+        (r"tok_weight$", ((tp, fsdp), None)),
+        (r"(q|k|v|gate|up|lm_head)_weight$", (tp, fsdp)),
+        (r"(o|down)_weight$", (fsdp, tp)),
+    ] + FSDP_TAIL(fsdp, tp)
+
+
+def bert_fsdp_rules(fsdp="fsdp", tp="tp"):
+    """ZeRO-3 layout for the BERT encoder (bert_rules + fsdp on the
+    non-tp matmul dim)."""
+    return [
+        (r"(attn_qkv|ffn1)_weight$", (tp, fsdp)),
+        (r"(attn_proj|ffn2)_weight$", (fsdp, tp)),
+        (r"decoder_weight$", (tp, fsdp)),
+        (r"position_weight$", ()),
+    ] + FSDP_TAIL(fsdp, tp)
+
+
+def transformer_fsdp_rules(fsdp="fsdp", tp="tp"):
+    """ZeRO-3 layout for the MT transformer (transformer_rules + fsdp
+    on the non-tp matmul dim)."""
+    return [
+        (r"(attn_qkv|self_qkv|cross_q|cross_kv|ffn1)_weight$",
+         (tp, fsdp)),
+        (r"(attn_proj|self_proj|cross_proj|ffn2)_weight$", (fsdp, tp)),
+    ] + FSDP_TAIL(fsdp, tp)
+
+
 _RULE_PACKS = {
     "llama": llama_rules,
     "bert": bert_rules,
     "transformer": transformer_rules,
 }
 
+_FSDP_PACKS = {
+    "llama_fsdp": llama_fsdp_rules,
+    "bert_fsdp": bert_fsdp_rules,
+    "transformer_fsdp": transformer_fsdp_rules,
+}
 
-def rule_pack(name, tp="tp"):
-    """A named zoo rule pack: ``rule_pack('llama')`` etc."""
-    if name not in _RULE_PACKS:
-        raise MXNetError(
-            f"unknown rule pack {name!r}; options {sorted(_RULE_PACKS)}")
-    return _RULE_PACKS[name](tp=tp)
+
+def rule_pack(name, tp="tp", fsdp="fsdp"):
+    """A named zoo rule pack: ``rule_pack('llama')``,
+    ``rule_pack('llama_fsdp')`` etc."""
+    if name in _RULE_PACKS:
+        return _RULE_PACKS[name](tp=tp)
+    if name in _FSDP_PACKS:
+        return _FSDP_PACKS[name](fsdp=fsdp, tp=tp)
+    raise MXNetError(
+        f"unknown rule pack {name!r}; options "
+        f"{sorted(_RULE_PACKS) + sorted(_FSDP_PACKS)}")

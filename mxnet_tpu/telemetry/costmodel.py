@@ -646,9 +646,22 @@ def _param_table(model_cfg):
 _EMBED_PAT = ("tok_", "word_", "embed", "position_")
 
 
+def _drop_axes(spec, drop):
+    """``spec`` with every axis in ``drop`` removed (per-dim entries keep
+    their remaining axes)."""
+    out = []
+    for entry in tuple(spec or ()):
+        entry = entry if isinstance(entry, (tuple, list)) \
+            else (entry,) if entry is not None else ()
+        kept = tuple(a for a in entry if a not in drop)
+        out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    return tuple(out)
+
+
 def estimate_memory(model_cfg, mesh_shape, rule_pack, batch, seq=None,
                     optimizer="adam", multi_precision=False,
-                    data_axes=("dp", "sp"), vocab=None):
+                    data_axes=("dp", "sp"), vocab=None,
+                    n_micro=1, remat=False, fsdp_axes=("fsdp",)):
     """Analytic per-device HBM (bytes) for ONE fused training step.
 
     Parameters
@@ -658,22 +671,41 @@ def estimate_memory(model_cfg, mesh_shape, rule_pack, batch, seq=None,
         matches against.
     mesh_shape : ``{'dp': 2, 'tp': 2, 'sp': 2}``, a DeviceMesh, or a
         ``(shape, axis_names)`` pair.
-    rule_pack : pack name (``'llama'``/``'bert'``/``'transformer'``), an
-        ordered ``(regex, spec)`` rule list, or None (fully replicated).
+    rule_pack : pack name (``'llama'``/``'llama_fsdp'``/``'bert'``/...),
+        an ordered ``(regex, spec)`` rule list, or None (fully
+        replicated).
     batch : GLOBAL batch size (samples).
     seq : tokens per sample (token models; None => 1, feature models).
     optimizer : 'adam' (m+v state) or 'sgd' (momentum assumed on).
     multi_precision : half-precision weights keep fp32 masters.
-    data_axes : mesh axes the token batch shards over (data_spec).
+    data_axes : mesh axes the token batch shards over (data_spec) —
+        include the fsdp axis for ZeRO-3 layouts (the batch rides it).
     vocab : LM-head width for the logits term; inferred from the widest
         embedding-named param when None.
+    n_micro : gradient-accumulation microbatches per step (TrainStep
+        ``n_micro``): live activations/logits divide by it, but a full
+        gradient ACCUMULATOR joins the working set (and under fsdp the
+        per-microbatch gradients live gathered inside the scan before
+        their reduce-scatter — both measured on the llama lane).
+    remat : TrainStep ``remat`` — saved activations halve (checkpointed
+        segment stores inputs; backward recomputes with roughly half the
+        residual set live).  XLA:CPU's compiled peak barely moves under
+        whole-net remat (its scheduler already overlaps fwd/bwd), so
+        remat'd estimates are NOT cross-checked against memory_analysis;
+        the planner treats remat as the last lever (PROFILE.md r11 has
+        the on-chip re-measurement recipe).
+    fsdp_axes : axes with gather-on-use semantics (params sharded along
+        them are all-gathered right before each matmul).
 
     Returns a breakdown dict whose ``total_bytes`` is the estimated
     steady-state peak for a donated step: live arguments (params +
     optimizer state + batch) plus the backward working set (gradients +
-    saved activations + the fp32 logits head).  Validated within 10% of
-    ``memory_analysis`` on the (2,2,2) llama dryrun lane — the input
-    contract for the auto-sharder (ROADMAP 3).
+    saved activations + the fp32 logits head + the fsdp gather
+    working set).  Validated against ``memory_analysis`` on the dryrun
+    llama lanes: 2.6% off on (2,2,2) dp×tp×sp, ~1% on dp×fsdp
+    (gather term = half the full-along-fsdp weight bytes, measured),
+    ~15% conservative on dp-only — the input contract for the
+    auto-sharder (ROADMAP 3).
     """
     axes = _mesh_axis_sizes(mesh_shape)
     table = _param_table(model_cfg)
@@ -693,17 +725,22 @@ def estimate_memory(model_cfg, mesh_shape, rule_pack, batch, seq=None,
     else:
         raise ValueError(f"estimate_memory: unknown optimizer "
                          f"{optimizer!r} (adam|sgd)")
+    n_micro = max(1, int(n_micro))
 
     tokens = int(batch) * int(seq or 1)
     data_div = 1
     for a in data_axes:
         data_div *= axes.get(a, 1)
     tokens_dev = max(1, tokens // data_div)
+    # only one microbatch's activations are live at a time
+    tokens_act = max(1, tokens_dev // n_micro)
 
     params_b = state_b = 0
     act_elems = 0.0
+    gathered_b = 0          # full-along-fsdp bytes of gather-on-use params
     inferred_vocab = 0
     seen_inputs = set()
+    fsdp_drop = frozenset(fsdp_axes)
     for name, (shape, itemsize) in table.items():
         spec = specs.get(name, ())
         numel = _sharded_numel(shape, spec, axes)
@@ -711,6 +748,13 @@ def estimate_memory(model_cfg, mesh_shape, rule_pack, batch, seq=None,
         state_b += numel * itemsize * n_state
         if multi_precision and itemsize < 4:
             state_b += numel * 4
+        nofsdp_spec = _drop_axes(spec, fsdp_drop)
+        gathered = _sharded_numel(shape, nofsdp_spec, axes)
+        if gathered != numel:
+            # actually fsdp-sharded (divisible, axis present): the
+            # all-gather before use materializes the full-along-fsdp
+            # weight (still divided by any tp axes it carries)
+            gathered_b += gathered * itemsize
         is_embed = any(p in name for p in _EMBED_PAT)
         if is_embed and len(shape) == 2:
             inferred_vocab = max(inferred_vocab, shape[0])
@@ -723,24 +767,49 @@ def estimate_memory(model_cfg, mesh_shape, rule_pack, batch, seq=None,
             # output that the residual stream keeps live.  Matmuls in
             # one layer reading the SAME activation (q/k/v, gate/up)
             # save it ONCE — dedup by (layer prefix, sharded width).
-            out_f = _sharded_numel((shape[0],), spec[:1], axes)
-            in_f = _sharded_numel((shape[1],), spec[1:2], axes) \
-                if len(spec) > 1 else shape[1]
+            # Activation widths use the NON-fsdp sharding: the matmul
+            # runs on the gathered weight, so activations shard only
+            # over tp-style axes.
+            out_f = _sharded_numel((shape[0],), nofsdp_spec[:1], axes)
+            in_f = _sharded_numel((shape[1],), nofsdp_spec[1:2], axes) \
+                if len(nofsdp_spec) > 1 else shape[1]
             layer_key = name.rsplit("_", 2)[0]
             if (layer_key, in_f) not in seen_inputs:
                 seen_inputs.add((layer_key, in_f))
-                act_elems += tokens_dev * in_f
-            act_elems += tokens_dev * out_f
+                act_elems += tokens_act * in_f
+            act_elems += tokens_act * out_f
 
     # fp32 logits head: softmax_cross_entropy upcasts and saves both the
     # logits and their softmax for backward
     v = int(vocab) if vocab else inferred_vocab
-    logits_b = 2 * tokens_dev * v * 4 if v else 0
-    # gradients live as temps through backward + the fused update
+    logits_b = 2 * tokens_act * v * 4 if v else 0
+    # gradients live as temps through backward + the fused update; a
+    # microbatched step additionally carries the accumulator, and under
+    # fsdp the in-scan per-microbatch gradients are FULL along fsdp
+    # until their reduce-scatter (measured: llama dp×fsdp micro lane)
     grads_b = params_b
+    if n_micro > 1:
+        grads_b += gathered_b if gathered_b else params_b
     acts_b = int(act_elems) * 4     # residuals saved in compute precision
+    if remat:
+        acts_b //= 2
+    # gather-on-use working set: roughly half the gathered weight bytes
+    # live at the peak while the scheduler can overlap gathers with
+    # frees (measured 195.4KB vs 197.6KB predicted on the llama
+    # dp2×fsdp4 lane) — but once the live ACTIVATION set outgrows that
+    # half, XLA holds the full gathered set (measured crossover on the
+    # batch-32 dp4×fsdp2 lane: half-model 14% under, full-model 3%
+    # over).  Inside a microbatch scan gathers can't overlap frees
+    # across the scan boundary at all, so the full set always counts
+    # there (fsdp micro2 lane: within 1.5% with this, 17% under
+    # without).
+    if n_micro > 1:
+        gather_b = gathered_b
+    else:
+        gather_b = min(gathered_b, max(gathered_b // 2, acts_b))
     batch_b = 2 * tokens_dev * 4    # data + label, int32 tokens
-    total = params_b + state_b + grads_b + batch_b + acts_b + logits_b
+    total = (params_b + state_b + grads_b + batch_b + acts_b + logits_b
+             + gather_b)
     return {
         "params_bytes": int(params_b),
         "opt_state_bytes": int(state_b),
@@ -748,8 +817,16 @@ def estimate_memory(model_cfg, mesh_shape, rule_pack, batch, seq=None,
         "batch_bytes": int(batch_b),
         "activation_bytes": int(acts_b),
         "logits_bytes": int(logits_b),
+        "fsdp_gather_bytes": int(gather_b),
+        # the UN-clamped full-along-fsdp weight bytes: what one step's
+        # all-gathers actually move per microbatch (the residency-
+        # clamped fsdp_gather_bytes above is a PEAK-MEMORY quantity and
+        # must not be used for communication accounting)
+        "fsdp_gathered_bytes": int(gathered_b),
         "total_bytes": int(total),
         "tokens_per_device": tokens_dev,
+        "n_micro": n_micro,
+        "remat": bool(remat),
         "mesh": dict(axes),
     }
 

@@ -1,0 +1,337 @@
+"""Auto-sharder (ISSUE 14): planner determinism, fit/no-fit semantics,
+Plan round-trip + TrainStep consumption, microbatched TrainStep
+bit-identity/parity, and the slow 8-device OOM-avoidance lane (the
+dryrun proof's pytest twin)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, autoshard, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn as gnn, loss as gloss
+from mxnet_tpu.telemetry import costmodel as cm
+
+
+def _llama_small_shapes(vocab=64):
+    """Shape-only param table (no weights) — the CLI's planning input
+    (the shared autoshard.zoo_shapes helper, so tests, CLI, and the
+    committed golden can't drift apart)."""
+    shapes, family = autoshard.zoo_shapes("llama_small", vocab=vocab)
+    assert family == "llama"
+    return shapes
+
+
+# ---------------------------------------------------------------------------
+# planner semantics
+# ---------------------------------------------------------------------------
+
+def test_infer_family():
+    assert autoshard.infer_family(_llama_small_shapes()) == "llama"
+    assert autoshard.infer_family(
+        ["b_attn_qkv_weight", "b_ffn1_weight"]) == "bert"
+    assert autoshard.infer_family(["w", "b"]) is None
+
+
+def test_unbounded_plan_prefers_pure_dp():
+    """With no budget the crossover doctrine keeps the simplest layout:
+    pure dp, no microbatching, no remat, replicated rules."""
+    p = autoshard.plan(_llama_small_shapes(), global_batch=16,
+                       n_devices=8, seq=16)
+    assert p.mesh_shape == {"dp": 8}
+    assert p.rule_pack is None and p.n_micro == 1 and not p.remat
+
+
+def test_budget_forces_fsdp_crossover():
+    """The 0.4×dp-only budget window (the dryrun proof's) must force a
+    model-parallel layout that carries fsdp, picked over same-ways tp
+    by the matmul-tile-efficiency term."""
+    shapes = _llama_small_shapes()
+    dp_only = cm.estimate_memory(shapes, {"dp": 8}, None, batch=16,
+                                 seq=16, data_axes=("dp",))
+    p = autoshard.plan(shapes, global_batch=16, n_devices=8, seq=16,
+                       hbm_budget_bytes=int(dp_only["total_bytes"] * 0.4))
+    assert "fsdp" in p.mesh_axes, p
+    assert p.rule_pack.endswith("_fsdp")
+    assert p.estimate["total_bytes"] <= int(dp_only["total_bytes"] * 0.4)
+
+
+def test_no_fit_raises_with_closest_candidate():
+    with pytest.raises(MXNetError, match="NO layout fits"):
+        autoshard.plan(_llama_small_shapes(), global_batch=16,
+                       n_devices=8, seq=16, hbm_budget_bytes=1000)
+
+
+def test_plan_deterministic_and_round_trips(tmp_path):
+    """Same inputs ⇒ byte-identical plan.json (the CI golden contract);
+    load_plan round-trips losslessly."""
+    shapes = _llama_small_shapes()
+    kw = dict(global_batch=16, n_devices=8, seq=16,
+              hbm_budget_bytes=20 << 20)
+    a = autoshard.plan(shapes, **kw)
+    b = autoshard.plan(shapes, **kw)
+    assert a.to_json() == b.to_json()
+    path = os.path.join(tmp_path, "plan.json")
+    a.save(path)
+    loaded = autoshard.load_plan(path)
+    assert loaded.to_json() == a.to_json()
+    assert loaded.mesh_shape == a.mesh_shape
+    assert loaded.data_spec == a.data_spec
+    # the artifact is valid sorted-key JSON with the schema version
+    d = json.loads(open(path).read())
+    assert d["version"] == autoshard.PLAN_VERSION
+
+
+def test_plan_version_mismatch_raises(tmp_path):
+    p = autoshard.plan(_llama_small_shapes(), global_batch=16,
+                       n_devices=8, seq=16)
+    d = p.to_dict()
+    d["version"] = 999
+    path = os.path.join(tmp_path, "bad.json")
+    with open(path, "w") as f:
+        json.dump(d, f)
+    with pytest.raises(MXNetError, match="version"):
+        autoshard.load_plan(path)
+
+
+def test_candidate_constraints():
+    """sp candidates require seq % sp == 0; batch must divide by
+    n_micro*dp*fsdp; every candidate's mesh multiplies to n_devices."""
+    cands, _fam = autoshard.enumerate_candidates(
+        _llama_small_shapes(), 8, global_batch=4, seq=6)
+    for c in cands:
+        m = c["mesh"]
+        total = 1
+        for s in m.values():
+            total *= s
+        assert total == 8
+        assert 6 % m.get("sp", 1) == 0
+        assert 4 % (c["n_micro"] * m.get("dp", 1)
+                    * m.get("fsdp", 1)) == 0
+
+
+def test_planner_telemetry_counters():
+    prev = telemetry.enable()
+    try:
+        c_plans = telemetry.counter("mxnet_autoshard_plans_total")
+        c_fits = telemetry.counter("mxnet_autoshard_fits_total")
+        c_nofit = telemetry.counter("mxnet_autoshard_no_fit_total")
+        p0, f0, n0 = c_plans.value, c_fits.value, c_nofit.value
+        autoshard.plan(_llama_small_shapes(), global_batch=16,
+                       n_devices=8, seq=16)
+        assert c_plans.value == p0 + 1
+        assert c_fits.value > f0
+        with pytest.raises(MXNetError):
+            autoshard.plan(_llama_small_shapes(), global_batch=16,
+                           n_devices=8, seq=16, hbm_budget_bytes=1)
+        assert c_nofit.value == n0 + 1
+    finally:
+        if not prev:
+            telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# estimator extensions (fsdp gather / n_micro / remat knobs)
+# ---------------------------------------------------------------------------
+
+def test_estimate_memory_fsdp_terms():
+    shapes = _llama_small_shapes()
+    base = cm.estimate_memory(shapes, {"dp": 2, "fsdp": 4},
+                              "llama_fsdp", batch=16, seq=16,
+                              data_axes=("dp", "fsdp"))
+    assert base["fsdp_gather_bytes"] > 0
+    # params/state shard ~4x vs dp-only (norms/biases replicate, so
+    # slightly above an exact quarter)
+    dp = cm.estimate_memory(shapes, {"dp": 8}, None, batch=16, seq=16,
+                            data_axes=("dp",))
+    assert dp["params_bytes"] / 4 <= base["params_bytes"] \
+        <= dp["params_bytes"] / 3.9
+    assert dp["opt_state_bytes"] / 4 <= base["opt_state_bytes"] \
+        <= dp["opt_state_bytes"] / 3.9
+    # microbatching: activations drop, a full-gather grad set joins
+    micro = cm.estimate_memory(shapes, {"dp": 2, "fsdp": 4},
+                               "llama_fsdp", batch=16, seq=16,
+                               data_axes=("dp", "fsdp"), n_micro=2)
+    assert micro["activation_bytes"] < base["activation_bytes"]
+    assert micro["grads_bytes"] > base["grads_bytes"]
+    assert micro["fsdp_gather_bytes"] >= base["fsdp_gather_bytes"]
+    # remat halves the modeled activation residency
+    remat = cm.estimate_memory(shapes, {"dp": 8}, None, batch=16,
+                               seq=16, data_axes=("dp",), remat=True)
+    assert remat["activation_bytes"] == dp["activation_bytes"] // 2
+
+
+def test_estimate_memory_indivisible_fsdp_dim_no_gather():
+    """A param whose dims the fsdp axis cannot divide degrades to
+    replicated — and must NOT be charged a gather."""
+    est = cm.estimate_memory({"w_q_weight": (7, 5)}, {"fsdp": 4},
+                             [(r".*", ("fsdp", None))], batch=4,
+                             data_axes=())
+    assert est["fsdp_gather_bytes"] == 0
+    assert est["params_bytes"] == 7 * 5 * 4      # fully replicated
+
+
+# ---------------------------------------------------------------------------
+# microbatched TrainStep (gradient accumulation)
+# ---------------------------------------------------------------------------
+
+def _tiny_net(seed=5):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = gnn.HybridSequential()
+    with net.name_scope():
+        net.add(gnn.Dense(16, activation="tanh", in_units=8))
+        net.add(gnn.Dense(4, in_units=16))
+    net.initialize(mx.initializer.Xavier())
+    return net
+
+
+def _micro_run(n_micro, steps=3, mesh=None, remat=False):
+    import jax
+    mesh = mesh or parallel.DeviceMesh(shape=(2,), axis_names=("dp",),
+                                       devices=jax.devices()[:2])
+    net = _tiny_net()
+    st = parallel.TrainStep(net, lambda o, l: gloss.L2Loss()(o, l),
+                            mx.optimizer.Adam(learning_rate=1e-2),
+                            mesh=mesh, n_micro=n_micro, remat=remat,
+                            donate=False)
+    x = np.random.RandomState(0).randn(8, 8).astype("float32")
+    y = np.random.RandomState(1).randn(8, 4).astype("float32")
+    losses = [float(st(nd.array(x), nd.array(y)).asscalar())
+              for _ in range(steps)]
+    return losses, [p.data().asnumpy().copy()
+                    for p in net.collect_params().values()], st
+
+
+def test_n_micro_1_bit_identical_to_default_step():
+    """The ISSUE 14 acceptance bar: an explicitly microbatched step at
+    n_micro=1 is BIT-identical to the existing TrainStep (same trace —
+    losses and every parameter byte equal)."""
+    l_def, p_def, _ = _micro_run(None)
+    l_one, p_one, _ = _micro_run(1)
+    assert l_def == l_one
+    for a, b in zip(p_def, p_one):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_n_micro_accumulation_parity():
+    """n_micro=2/4 match the single-pass trajectory within fp tolerance
+    (mean-of-micro-means == full-batch mean for per-sample-mean losses;
+    accumulation is fixed-association so the result is deterministic)."""
+    l_one, p_one, _ = _micro_run(1)
+    for n in (2, 4):
+        l_n, p_n, _ = _micro_run(n)
+        np.testing.assert_allclose(l_n, l_one, rtol=2e-4)
+        for a, b in zip(p_one, p_n):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6)
+        # determinism: same n_micro twice is bitwise-equal
+        l_n2, p_n2, _ = _micro_run(n)
+        assert l_n == l_n2
+        for a, b in zip(p_n, p_n2):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_n_micro_remat_composition():
+    """remat composes with microbatching (single-output net) at parity."""
+    l_one, _, _ = _micro_run(1)
+    l_r, _, _ = _micro_run(2, remat=True)
+    np.testing.assert_allclose(l_r, l_one, rtol=2e-4)
+
+
+def test_n_micro_divisibility_raises():
+    import jax
+    mesh = parallel.DeviceMesh(shape=(2,), axis_names=("dp",),
+                               devices=jax.devices()[:2])
+    st = parallel.TrainStep(_tiny_net(), lambda o, l: gloss.L2Loss()(o, l),
+                            "sgd", {"learning_rate": 0.1}, mesh=mesh,
+                            n_micro=3, donate=False)
+    with pytest.raises(MXNetError, match="divisible"):
+        st(nd.array(np.zeros((8, 8), "float32")),
+           nd.array(np.zeros((8, 4), "float32")))
+    with pytest.raises(MXNetError, match="n_micro"):
+        parallel.TrainStep(_tiny_net(), lambda o, l: o, "sgd",
+                           {"learning_rate": 0.1}, mesh=mesh, n_micro=0)
+
+
+def test_microbatch_knob_default(monkeypatch):
+    monkeypatch.setenv("MXNET_MICROBATCH", "2")
+    import jax
+    mesh = parallel.DeviceMesh(shape=(2,), axis_names=("dp",),
+                               devices=jax.devices()[:2])
+    st = parallel.TrainStep(_tiny_net(), lambda o, l: gloss.L2Loss()(o, l),
+                            "sgd", {"learning_rate": 0.1}, mesh=mesh,
+                            donate=False)
+    assert st._n_micro == 2
+
+
+def test_run_stacked_with_microbatching():
+    """run() (the lax.scan multi-step path) composes with n_micro."""
+    import jax
+    mesh = parallel.DeviceMesh(shape=(2,), axis_names=("dp",),
+                               devices=jax.devices()[:2])
+    net = _tiny_net()
+    st = parallel.TrainStep(net, lambda o, l: gloss.L2Loss()(o, l),
+                            mx.optimizer.Adam(learning_rate=1e-2),
+                            mesh=mesh, n_micro=2, donate=False)
+    x = np.random.RandomState(0).randn(2, 8, 8).astype("float32")
+    y = np.random.RandomState(1).randn(2, 8, 4).astype("float32")
+    losses = st.run(nd.array(x), nd.array(y)).asnumpy()
+    assert losses.shape == (2,) and np.isfinite(losses).all()
+
+
+# ---------------------------------------------------------------------------
+# the 8-device OOM-avoidance lane (dryrun proof's pytest twin; slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_autoshard_oom_avoidance_8dev():
+    """Estimator-confirmed dp-only OOM model trains under the
+    auto-chosen fsdp layout with loss parity and no retrace on the
+    8-device virtual mesh — TrainStep consuming the Plan directly."""
+    from mxnet_tpu.analysis.runtime import no_retrace
+    from mxnet_tpu.gluon.model_zoo.llama import llama_model
+
+    vocab, seq, batch = 64, 16, 16
+
+    def llama_loss(o, l):
+        return mx.nd.softmax_cross_entropy(
+            o.reshape((-1, o.shape[-1])), l.reshape((-1,))) / l.size
+
+    toks = np.random.RandomState(23).randint(
+        0, vocab, (batch, seq)).astype("int32")
+    labs = np.roll(toks, -1, axis=1).astype("int32")
+
+    mx.random.seed(29)
+    probe = llama_model("llama_small", vocab_size=vocab)
+    probe.initialize(mx.initializer.Normal(0.05))
+    dp_est = cm.estimate_memory(probe, {"dp": 8}, None, batch=batch,
+                                seq=seq, data_axes=("dp",))["total_bytes"]
+    budget = int(dp_est * 0.4)
+    plan = autoshard.plan(probe, global_batch=batch, seq=seq,
+                          n_devices=8, hbm_budget_bytes=budget)
+    assert "fsdp" in plan.mesh_axes
+
+    def run(mesh=None, use_plan=None, steps=2):
+        mx.random.seed(29)
+        net = llama_model("llama_small", vocab_size=vocab)
+        net.initialize(mx.initializer.Normal(0.05))
+        st = parallel.TrainStep(
+            net, llama_loss, mx.optimizer.Adam(learning_rate=1e-3),
+            mesh=mesh, donate=False, plan=use_plan)
+        return net, st, [float(st(nd.array(toks, dtype="int32"),
+                                  nd.array(labs, dtype="int32"))
+                               .asscalar()) for _ in range(steps)]
+
+    _, _, dense = run(mesh=parallel.DeviceMesh(shape=(8,),
+                                               axis_names=("dp",)))
+    net_p, st_p, sharded = run(use_plan=plan)
+    np.testing.assert_allclose(sharded, dense, rtol=2e-4)
+    q = next(p for n, p in net_p.collect_params().items()
+             if n.endswith("layer0_q_weight"))._data._data
+    assert "fsdp" in str(q.sharding.spec)
+    with no_retrace():
+        st_p(nd.array(toks, dtype="int32"),
+             nd.array(labs, dtype="int32"))

@@ -363,3 +363,157 @@ def test_masked_encdec_att_grads_flow():
     loss.backward()
     assert np.isfinite(q.grad.asnumpy()).all()
     assert np.abs(kv.grad.asnumpy()).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# multihead_attention_* named wrappers (ISSUE 14 satellite; VERDICT
+# missing #2): parity against ops.contrib._dense_sdpa, the tree's ONE
+# attention-numerics oracle.
+# ---------------------------------------------------------------------------
+
+def _mha_ref(q, k, v, H, valid_length=None, causal=False):
+    """Key-only-masked oracle on (L, B, H*D) inputs: _dense_sdpa for the
+    mask-free cases (the shared numerics core) and an explicit
+    keys-masked softmax otherwise — queries are ALWAYS valid, the op's
+    documented contract (independent of Lq == Lk)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.contrib import _dense_sdpa
+
+    def heads(x):
+        L, B, E = x.shape
+        return jnp.transpose(
+            jnp.asarray(x).reshape(L, B, H, E // H), (1, 2, 0, 3))
+
+    D = q.shape[-1] // H
+    Lq, B = q.shape[0], q.shape[1]
+    if valid_length is None:
+        out = np.asarray(_dense_sdpa(heads(q), heads(k), heads(v), None,
+                                     causal, 1.0 / float(D) ** 0.5))
+        return out.transpose(2, 0, 1, 3).reshape(Lq, B, -1)
+    Lk = k.shape[0]
+    att = np.einsum("qbhd,kbhd->bhqk",
+                    q.reshape(Lq, B, H, D) / np.sqrt(D),
+                    k.reshape(Lk, B, H, D))
+    att = np.where((np.arange(Lk)[None, :] < valid_length[:, None])
+                   [:, None, None, :], att, -1e9)
+    if causal:
+        att = np.where(np.tril(np.ones((Lq, Lk), bool))[None, None],
+                       att, -1e9)
+    p = np.exp(att - att.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,kbhd->qbhd", p,
+                     v.reshape(Lk, B, H, D)).reshape(Lq, B, H * D)
+
+
+def test_multihead_attention_matches_dense_sdpa():
+    r = np.random.RandomState(11)
+    L, B, H, D = 6, 3, 2, 4
+    q = r.randn(L, B, H * D).astype(np.float32)
+    k = r.randn(L, B, H * D).astype(np.float32)
+    v = r.randn(L, B, H * D).astype(np.float32)
+    for vl, causal in ((None, False), (np.array([6, 3, 5]), False),
+                      (None, True), (np.array([4, 6, 2]), True)):
+        got = nd.contrib.multihead_attention(
+            nd.array(q), nd.array(k), nd.array(v),
+            None if vl is None else nd.array(vl.astype(np.float32)),
+            heads=H, causal=causal).asnumpy()
+        want = _mha_ref(q, k, v, H, valid_length=vl, causal=causal)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"vl={vl} causal={causal}")
+
+
+def test_multihead_attention_cross_lengths():
+    """Lq != Lk takes the cross path: key-side masking only."""
+    r = np.random.RandomState(12)
+    Lq, Lk, B, H, D = 5, 9, 2, 2, 4
+    q = r.randn(Lq, B, H * D).astype(np.float32)
+    k = r.randn(Lk, B, H * D).astype(np.float32)
+    v = r.randn(Lk, B, H * D).astype(np.float32)
+    vl = np.array([9, 4])
+    got = nd.contrib.multihead_attention(
+        nd.array(q), nd.array(k), nd.array(v),
+        nd.array(vl.astype(np.float32)), heads=H).asnumpy()
+    # oracle: _dense_sdpa_cross == _dense_sdpa with key-side-only seg;
+    # build it by masking scores directly
+    att = np.einsum("qbhd,kbhd->bhqk",
+                    q.reshape(Lq, B, H, D) / np.sqrt(D),
+                    k.reshape(Lk, B, H, D))
+    att = np.where((np.arange(Lk)[None, :] < vl[:, None])
+                   [:, None, None, :], att, -1e9)
+    p = np.exp(att - att.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,kbhd->qbhd", p,
+                     v.reshape(Lk, B, H, D)).reshape(Lq, B, H * D)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_multihead_attention_mask_independent_of_length_coincidence():
+    """Key-only masking must NOT flip to the self-attention two-sided
+    mask just because Lq happens to equal Lk (review regression): the
+    first Lq query rows of an (Lq, Lk+1)-shaped cross call — key row
+    Lk padded away by valid_length — must equal the (Lq, Lq)-shaped
+    call on the same keys."""
+    r = np.random.RandomState(15)
+    L, B, H, D = 6, 2, 2, 4
+    q = r.randn(L, B, H * D).astype(np.float32)
+    k = r.randn(L + 1, B, H * D).astype(np.float32)
+    v = r.randn(L + 1, B, H * D).astype(np.float32)
+    vl = np.array([3.0, 5.0], np.float32)
+    eq = nd.contrib.multihead_attention(
+        nd.array(q), nd.array(k[:L]), nd.array(v[:L]), nd.array(vl),
+        heads=H).asnumpy()
+    cross = nd.contrib.multihead_attention(
+        nd.array(q), nd.array(k), nd.array(v), nd.array(vl),
+        heads=H).asnumpy()
+    np.testing.assert_allclose(eq, cross, rtol=1e-5, atol=1e-6)
+
+
+def test_multihead_attention_causal_cross_raises():
+    r = np.random.RandomState(16)
+    q = nd.array(r.randn(4, 2, 8).astype(np.float32))
+    kv = nd.array(r.randn(5, 2, 8).astype(np.float32))
+    with pytest.raises(mx.base.MXNetError, match="causal"):
+        nd.contrib.multihead_attention(q, kv, kv, heads=2, causal=True)
+
+
+def test_multihead_attention_qk_valatt_chain():
+    """qk → softmax → valatt ≡ the fused op (all-valid, non-causal) —
+    and the qk scores match the interleaved op's on the same content."""
+    r = np.random.RandomState(13)
+    L, B, H, D = 6, 2, 2, 4
+    q = r.randn(L, B, H * D).astype(np.float32)
+    k = r.randn(L, B, H * D).astype(np.float32)
+    v = r.randn(L, B, H * D).astype(np.float32)
+    att = nd.contrib.multihead_attention_qk(nd.array(q), nd.array(k),
+                                            heads=H).asnumpy()
+    assert att.shape == (B * H, L, L)
+    p = np.exp(att - att.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    chain = nd.contrib.multihead_attention_valatt(
+        nd.array(p.astype(np.float32)), nd.array(v), heads=H).asnumpy()
+    fused = nd.contrib.multihead_attention(
+        nd.array(q), nd.array(k), nd.array(v), heads=H).asnumpy()
+    np.testing.assert_allclose(chain, fused, rtol=1e-4, atol=1e-5)
+    # scores equal the interleaved op's on identically-interleaved qkv
+    qkv = np.stack([q.reshape(L, B, H, D), k.reshape(L, B, H, D),
+                    v.reshape(L, B, H, D)], axis=3).reshape(L, B, 3 * H * D)
+    want = nd.contrib.interleaved_matmul_selfatt_qk(
+        nd.array(qkv), heads=H).asnumpy()
+    np.testing.assert_allclose(att, want, rtol=1e-5, atol=1e-6)
+
+
+def test_multihead_attention_grads_flow():
+    r = np.random.RandomState(14)
+    q = nd.array(r.randn(4, 2, 8).astype(np.float32))
+    k = nd.array(r.randn(4, 2, 8).astype(np.float32))
+    v = nd.array(r.randn(4, 2, 8).astype(np.float32))
+    for x in (q, k, v):
+        x.attach_grad()
+    with autograd.record():
+        out = nd.contrib.multihead_attention(q, k, v, heads=2,
+                                             causal=True)
+        loss = (out * out).sum()
+    loss.backward()
+    for x in (q, k, v):
+        assert np.isfinite(x.grad.asnumpy()).all()
+        assert np.abs(x.grad.asnumpy()).sum() > 0

@@ -430,3 +430,96 @@ def test_checkpoint_roundtrips_sharded_params(tmp_path, monkeypatch,
     for k in ref:
         np.testing.assert_allclose(got[k], ref[k], rtol=1e-6, atol=1e-7,
                                    err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# fsdp rule packs (ISSUE 14): ZeRO-3 resolution edge cases
+# ---------------------------------------------------------------------------
+
+def test_fsdp_pack_composes_with_tp_on_same_mesh():
+    """llama_fsdp_rules on a dp×fsdp×tp mesh: column-parallel weights
+    carry tp on dim0 AND fsdp on dim1; row-parallel the mirror; the
+    embedding shards vocab over both."""
+    import jax
+    mesh = parallel.DeviceMesh(shape=(2, 2, 2),
+                               axis_names=("dp", "fsdp", "tp"))
+    specs = sharding.match_partition_rules(
+        sharding.llama_fsdp_rules(),
+        {"m_tok_weight": (64, 16), "m_layer0_q_weight": (16, 16),
+         "m_layer0_down_weight": (16, 44), "m_layer0_attn_norm_weight":
+         (16,), "m_scale": ()})
+    assert specs["m_layer0_q_weight"] == ("tp", "fsdp")
+    assert specs["m_layer0_down_weight"] == ("fsdp", "tp")
+    assert specs["m_tok_weight"] == (("tp", "fsdp"), None)
+    assert specs["m_layer0_attn_norm_weight"] == ()   # norms replicate
+    assert specs["m_scale"] == ()                     # scalars never shard
+    sh, did = sharding.resolve_spec(specs["m_layer0_q_weight"], mesh,
+                                    shape=(16, 16))
+    assert did and str(sh.spec) == str(
+        jax.sharding.PartitionSpec("tp", "fsdp"))
+
+
+def test_fsdp_pack_degrades_without_fsdp_axis():
+    """The same rule set on a mesh WITHOUT fsdp resolves to the pure tp
+    layout (one rule set per model, every mesh) — and on a dp-only mesh
+    to full replication."""
+    tp_mesh = parallel.DeviceMesh(shape=(4, 2), axis_names=("dp", "tp"))
+    sh, did = sharding.resolve_spec(("tp", "fsdp"), tp_mesh,
+                                    shape=(16, 16))
+    assert did and "tp" in str(sh.spec) and "fsdp" not in str(sh.spec)
+    dp_mesh = parallel.DeviceMesh(shape=(8,), axis_names=("dp",))
+    sh, did = sharding.resolve_spec(("tp", "fsdp"), dp_mesh,
+                                    shape=(16, 16))
+    assert not did    # full replication, bit-identity contract
+
+
+def test_fsdp_indivisible_dim_degrades_to_replicated():
+    """A dim not divisible by its fsdp axis (or the tp×fsdp product on
+    a combined entry) drops to unsharded instead of erroring."""
+    mesh = parallel.DeviceMesh(shape=(2, 2, 2),
+                               axis_names=("dp", "fsdp", "tp"))
+    # dim1 = 7 not divisible by fsdp=2 -> that dim unsharded, dim0 keeps tp
+    sh, did = sharding.resolve_spec(("tp", "fsdp"), mesh, shape=(16, 7))
+    assert did
+    s = str(sh.spec)
+    assert "tp" in s and "fsdp" not in s
+    # combined ('tp','fsdp') entry over a dim divisible by 2 but not 4
+    sh, did = sharding.resolve_spec((("tp", "fsdp"), None), mesh,
+                                    shape=(6, 16))
+    assert not did    # 6 % (2*2) != 0 -> whole entry degrades
+
+
+def test_fsdp_scalar_state_replicates_in_trainstep():
+    """Optimizer state that does not match its owner param's shape
+    (scalar / odd-shaped state) replicates even under an fsdp pack,
+    while same-shaped adam state rides the param's fsdp layout."""
+    import numpy as np
+    from mxnet_tpu.gluon import nn as gnn, loss as gloss
+    mesh = parallel.DeviceMesh(shape=(2, 2, 2),
+                               axis_names=("dp", "fsdp", "tp"))
+    mx.random.seed(3)
+    net = gnn.Dense(16, flatten=False, in_units=16, use_bias=False,
+                    prefix="fsdpnet_")
+    net.initialize(mx.initializer.Xavier())
+    st = parallel.TrainStep(
+        net, lambda o, l: gloss.L2Loss()(o, l),
+        mx.optimizer.Adam(learning_rate=0.1), mesh=mesh, donate=False,
+        partition_rules=[(r"weight$", ("tp", "fsdp"))],
+        data_spec=(("dp", "fsdp"),))
+    x = np.random.RandomState(0).randn(8, 16).astype("float32")
+    y = np.random.RandomState(1).randn(8, 16).astype("float32")
+    st(nd.array(x), nd.array(y))
+    p_sh, s_sh = st._shardings()
+    # every adam m/v state is weight-shaped here: all ride the layout
+    assert all("fsdp" in str(sh.spec) for sh in p_sh)
+    assert all("fsdp" in str(sh.spec) for sh in s_sh)
+    # scalar state (shape != owner param's): the mismatch branch must
+    # replicate it — inject one scalar state NDArray next to the real
+    # adam slots and re-resolve
+    st._state_nds = st._state_nds + [nd.zeros(())]
+    st._state_owner = st._state_owner + [0]
+    st._p_sh = st._s_sh = None
+    _, s_sh2 = st._shardings()
+    assert "fsdp" not in str(s_sh2[-1].spec) \
+        and str(s_sh2[-1].spec) == "PartitionSpec()"
+    assert all("fsdp" in str(sh.spec) for sh in s_sh2[:-1])

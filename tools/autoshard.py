@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""autoshard — pick mesh + rule pack + microbatch/remat under an HBM
+budget, analytically (ISSUE 14; the ROADMAP-3 auto-sharder CLI).
+
+    python tools/autoshard.py --model llama_small --batch 16 --seq 16 \\
+        --devices 8 --hbm-mb 20 --out plan.json
+
+Prints the scored candidate table (fit verdict per layout) and writes
+the chosen ``plan.json`` — a deterministic artifact (same inputs ⇒
+byte-identical file; CI goldens it) that ``parallel.TrainStep(plan=
+autoshard.load_plan(path))`` consumes directly.
+
+Model selection: ``--model`` names a zoo config (``llama_tiny``,
+``llama_small``, ``llama3_8b``, ``bert_...``, ``transformer``-family via
+``--family``), or ``--shapes shapes.json`` supplies a raw
+``{param_name: shape}`` table for models not in the zoo.  Zoo models
+build param SHAPES only — no weights are initialized, so planning an
+llama3_8b layout needs megabytes, not the model.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="analytic auto-sharder: mesh + rules + microbatch "
+                    "under an HBM budget")
+    ap.add_argument("--model", help="zoo config name (llama_*, bert_*)")
+    ap.add_argument("--shapes", help="JSON file {param_name: shape}")
+    ap.add_argument("--family", default=None,
+                    help="rule-pack family override "
+                         "(llama|bert|transformer; inferred by default)")
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--batch", type=int, required=True,
+                    help="GLOBAL batch size")
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--devices", type=int, required=True)
+    ap.add_argument("--hbm-mb", type=float, default=None,
+                    help="per-device HBM budget (MB); default knob "
+                         "MXNET_AUTOSHARD_HBM_GB, else unbounded")
+    ap.add_argument("--optimizer", default="adam",
+                    choices=("adam", "sgd"))
+    ap.add_argument("--multi-precision", action="store_true")
+    ap.add_argument("--max-micro", type=int, default=None)
+    ap.add_argument("--no-remat", action="store_true",
+                    help="exclude remat candidates")
+    ap.add_argument("--candidates", type=int, default=12,
+                    help="how many scored candidates to print")
+    ap.add_argument("--out", default=None, help="write plan.json here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the plan JSON to stdout instead of the "
+                         "table")
+    args = ap.parse_args(argv)
+
+    if bool(args.model) == bool(args.shapes):
+        raise SystemExit("autoshard: exactly one of --model/--shapes")
+    from mxnet_tpu import autoshard
+    from mxnet_tpu.base import MXNetError
+
+    if args.shapes:
+        with open(args.shapes) as f:
+            shapes = {k: tuple(v) for k, v in json.load(f).items()}
+        family = args.family
+    else:
+        try:
+            shapes, family = autoshard.zoo_shapes(args.model,
+                                                  vocab=args.vocab)
+        except MXNetError as e:
+            raise SystemExit(f"autoshard: {e}")
+        family = args.family or family
+
+    if args.hbm_mb is not None:
+        budget = int(args.hbm_mb * 2 ** 20)
+    else:
+        # resolve the knob fallback HERE so the printed table's fit
+        # column and the chosen plan agree (plan() applies the same
+        # default when hbm_budget_bytes is None)
+        from mxnet_tpu import config as _config
+        gb = _config.get_float("MXNET_AUTOSHARD_HBM_GB", 0.0)
+        budget = int(gb * 2 ** 30) if gb > 0 else None
+    cands, family = autoshard.enumerate_candidates(
+        shapes, args.devices, args.batch, seq=args.seq, family=family,
+        optimizer=args.optimizer, multi_precision=args.multi_precision,
+        max_micro=args.max_micro, allow_remat=not args.no_remat)
+    try:
+        plan = autoshard.plan(
+            shapes, args.batch, n_devices=args.devices, seq=args.seq,
+            hbm_budget_bytes=budget, candidates=(cands, family))
+    except MXNetError as e:
+        print(f"NO FIT: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(plan.to_json(), end="")
+    else:
+        print(f"{len(cands)} candidates for {args.devices} devices, "
+              f"batch {args.batch}"
+              + (f", seq {args.seq}" if args.seq else "")
+              + (f", budget {budget / 2**20:.1f}MB/dev" if budget
+                 else ", unbounded")
+              + f" (family {family}):")
+        print(f"  {'mesh':<24} {'pack':<18} {'micro':>5} {'remat':>5} "
+              f"{'est MB/dev':>11} {'fit':>4} {'step est':>10} "
+              f"{'eff':>5}")
+        for c in cands[:args.candidates]:
+            dims = "x".join(f"{a}{s}" for a, s in sorted(
+                c["mesh"].items(),
+                key=lambda kv: ("dp", "fsdp", "tp", "sp").index(kv[0])))
+            tot = c["estimate"]["total_bytes"]
+            fit = "yes" if budget is None or tot <= budget else "no"
+            print(f"  {dims:<24} {str(c['rule_pack']):<18} "
+                  f"{c['n_micro']:>5} {str(c['remat']):>5} "
+                  f"{tot / 2**20:>11.2f} {fit:>4} "
+                  f"{c['step_time_s']:>10.2e} {c['matmul_eff']:>5.2f}")
+        print(f"chosen: {plan}")
+    if args.out:
+        plan.save(args.out)
+        print(f"plan written to {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
