@@ -1,4 +1,5 @@
-"""Serving-engine benchmark: FLOPs per generated token + sustained req/s.
+"""Serving-engine benchmark: FLOPs per generated token + sustained req/s
++ prefix-cache prefill savings + speculative tokens-per-dispatch.
 
 ISSUE 6 acceptance lanes, both CPU-runnable and gated in CI:
 
@@ -18,6 +19,23 @@ ISSUE 6 acceptance lanes, both CPU-runnable and gated in CI:
    strands short requests behind the batch's longest sequence; the
    continuous scheduler backfills the freed slots, so requests/sec rises
    while per-request p99 (queue wait included) falls.
+
+ISSUE 15 lanes (also default, counter-based like lane 1):
+
+3. **prefix cache (>= 2x prefill positions)** — a shared-system-prompt
+   workload (every request = one system prompt + a unique tail) through
+   the same engine with `prefix_cache` off vs on.  Both sides COUNT
+   prefill positions via `mxnet_serving_prefill_positions_total`
+   (padding included), outputs are asserted token-identical, and the
+   hit/evict/COW telemetry plus the prefix-hit TTFT delta ride the
+   summary row.
+
+4. **speculative decode (>= 1.5x generated tokens per target
+   dispatch)** — an identically-seeded draft (acceptance ~1.0: the
+   mechanism ceiling) gates tokens/dispatch >= 1.5 at spec_k drafts per
+   iteration, outputs asserted bit-identical to non-speculative greedy;
+   a divergent-seed draft row reports the measured low-acceptance end
+   ungated (accepted-draft histogram mean embedded in both rows).
 
 Usage:
     python benchmark/serve_bench.py [--config llama_tiny] [--vocab 101]
@@ -118,6 +136,150 @@ def bench_flops_per_token(net, args):
                "pass_8x": ratio >= 8.0}
     print(json.dumps(summary))
     return summary["pass_8x"]
+
+
+def bench_prefix_cache(net, args):
+    """Lane 3 (ISSUE 15): shared-system-prompt workload, prefix cache
+    off vs on — position-counted prefill flops ratio >= 2x at equal
+    (token-identical) output."""
+    from mxnet_tpu import serving, telemetry
+
+    r = np.random.RandomState(args.seed + 2)
+    T = args.block_tokens
+    sys_prompt = list(r.randint(3, args.vocab, 3 * T))    # 3 full blocks
+    prompts = [sys_prompt + list(r.randint(3, args.vocab,
+                                           int(r.randint(2, 6))))
+               for _ in range(2 * args.max_batch)]
+    need = max(len(p) for p in prompts)
+    if need > args.prefill_tokens_prefix:
+        raise SystemExit(f"prefix lane misfit: longest prompt {need} > "
+                         f"prefill shape {args.prefill_tokens_prefix}")
+    pos_c = telemetry.counter("mxnet_serving_prefill_positions_total")
+    results = {}
+    for mode in (False, True):
+        eng = serving.ServingEngine(
+            net, eos_id=NEVER_EOS, max_batch=args.max_batch,
+            block_tokens=T, max_seq=args.tp_max_seq,
+            prefill_tokens=args.prefill_tokens_prefix, prefix_cache=mode)
+        # warmup compiles the cold prefill AND (second request) the
+        # tail-chunk path, so the timed window (and its TTFT samples)
+        # holds no compile; the warmup's index entries are the same ones
+        # request 0 would have registered
+        eng.generate([prompts[0], list(prompts[0])], max_new_tokens=2)
+        p0 = pos_c.value
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, max_new_tokens=args.gen_tokens // 2)
+                   for p in prompts]
+        eng.drain()
+        wall = time.perf_counter() - t0
+        outs = [h.result(timeout=60) for h in handles]
+        ttft = [h.stats()["ttft_s"] for h in handles]
+        results[mode] = {
+            "prefill_positions": pos_c.value - p0,
+            "outs": outs, "wall_s": round(wall, 4),
+            "mean_ttft_s": round(float(np.mean(ttft)), 6),
+            "hits": eng.cache.prefix_hits,
+            "hit_tokens": eng.cache.prefix_hit_tokens,
+            "evictions": eng.cache.evictions,
+            "cow": eng.cache.cow_copies,
+        }
+    assert results[True]["outs"] == results[False]["outs"], \
+        "prefix-cache-hit generations diverged from the cold path"
+    ratio = results[False]["prefill_positions"] \
+        / max(results[True]["prefill_positions"], 1)
+    for mode in (False, True):
+        rec = dict(results[mode])
+        rec.pop("outs")
+        print(json.dumps({"metric": "serve_prefix_prefill",
+                          "prefix_cache": mode, **rec}))
+    summary = {
+        "metric": "serve_prefix_ratio",
+        "prefill_positions_ratio": round(ratio, 2),
+        "token_identical": True,
+        "ttft_delta_s": round(results[False]["mean_ttft_s"]
+                              - results[True]["mean_ttft_s"], 6),
+        "hits": results[True]["hits"],
+        "hit_tokens": results[True]["hit_tokens"],
+        "evictions": results[True]["evictions"],
+        "cow": results[True]["cow"],
+        "pass_2x": ratio >= 2.0,
+    }
+    print(json.dumps(summary))
+    return summary["pass_2x"]
+
+
+def bench_spec_decode(net, args):
+    """Lane 4 (ISSUE 15): speculative decoding tokens-per-target-
+    dispatch, gated >= 1.5x on the identically-seeded draft (acceptance
+    ~1.0) and reported ungated on a divergent draft."""
+    from mxnet_tpu import serving, telemetry
+
+    r = np.random.RandomState(args.seed + 3)
+    prompts = [list(r.randint(3, args.vocab, int(r.randint(3, 10))))
+               for _ in range(args.max_batch)]
+    gen = args.gen_tokens
+    tok_c = telemetry.counter("mxnet_serving_tokens_total")
+    step_c = telemetry.counter("mxnet_serving_decode_steps_total")
+
+    def run(draft, label):
+        eng = serving.ServingEngine(
+            net, eos_id=NEVER_EOS, max_batch=args.max_batch,
+            block_tokens=args.block_tokens, max_seq=args.tp_max_seq,
+            prefill_tokens=args.prefill_tokens, draft_model=draft,
+            spec_k=args.spec_k)
+        eng.generate(prompts[:1], max_new_tokens=2)        # compile warmup
+        hist = telemetry.REGISTRY.get("mxnet_serving_accepted_draft_tokens")
+        hs0, hc0 = (hist.sum, hist.count) if hist is not None else (0, 0)
+        t0, s0 = tok_c.value, step_c.value
+        w0 = time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=gen)
+        wall = time.perf_counter() - w0
+        toks, steps = tok_c.value - t0, step_c.value - s0
+        hist = telemetry.REGISTRY.get("mxnet_serving_accepted_draft_tokens")
+        hn = 0 if hist is None else hist.count - hc0
+        acc = 0.0 if hn == 0 else (hist.sum - hs0) / hn
+        rec = {"metric": "serve_spec_decode", "mode": label,
+               "spec_k": args.spec_k, "tokens": toks,
+               "target_dispatches": steps,
+               "tokens_per_dispatch": round(toks / max(steps, 1), 2),
+               "mean_accepted_drafts": round(acc, 2),
+               "acceptance_rate": round(acc / max(args.spec_k, 1), 3),
+               "wall_s": round(wall, 4)}
+        print(json.dumps(rec))
+        return outs, rec
+
+    base_eng = serving.ServingEngine(
+        net, eos_id=NEVER_EOS, max_batch=args.max_batch,
+        block_tokens=args.block_tokens, max_seq=args.tp_max_seq,
+        prefill_tokens=args.prefill_tokens)
+    base_eng.generate(prompts[:1], max_new_tokens=2)
+    t0, s0 = tok_c.value, step_c.value
+    base = base_eng.generate(prompts, max_new_tokens=gen)
+    base_tpd = (tok_c.value - t0) / max(step_c.value - s0, 1)
+    print(json.dumps({"metric": "serve_spec_decode", "mode": "no_spec",
+                      "tokens": tok_c.value - t0,
+                      "target_dispatches": step_c.value - s0,
+                      "tokens_per_dispatch": round(base_tpd, 2)}))
+
+    twin = build_model(args.config, args.vocab, args.seed)  # acceptance ~1
+    outs_t, rec_t = run(twin, "identical_draft")
+    div = build_model(args.spec_draft or args.config, args.vocab,
+                      args.seed + 1)                        # measured low end
+    outs_d, rec_d = run(div, "divergent_draft")
+    assert outs_t == base and outs_d == base, \
+        "speculative greedy output diverged from non-speculative greedy"
+    ratio = rec_t["tokens_per_dispatch"] / max(base_tpd, 1e-9)
+    summary = {"metric": "serve_spec_ratio",
+               "tokens_per_dispatch_ratio": round(ratio, 2),
+               "tokens_per_dispatch": rec_t["tokens_per_dispatch"],
+               "acceptance_rate": rec_t["acceptance_rate"],
+               "divergent_tokens_per_dispatch":
+                   rec_d["tokens_per_dispatch"],
+               "divergent_acceptance_rate": rec_d["acceptance_rate"],
+               "token_identical": True,
+               "pass_1p5x": ratio >= 1.5}
+    print(json.dumps(summary))
+    return summary["pass_1p5x"]
 
 
 def _mixed_workload(args):
@@ -350,6 +512,14 @@ def main():
                     help="re-encode baseline's fixed buffer length")
     ap.add_argument("--tp-max-seq", type=int, default=128,
                     help="throughput lane max_seq (prompt+gen cap)")
+    ap.add_argument("--prefill-tokens-prefix", type=int, default=64,
+                    help="prefix lane's padded prefill shape (must hold "
+                         "the 3-block system prompt + tails)")
+    ap.add_argument("--spec-k", type=int, default=3,
+                    help="speculative lane draft tokens per iteration")
+    ap.add_argument("--spec-draft", default=None,
+                    help="zoo config of the DIVERGENT draft row "
+                         "(default: --config at seed+1)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--router", action="store_true",
                     help="run ONLY the router scale-out lane (ISSUE 13: "
@@ -377,7 +547,9 @@ def main():
         return
     ok_flops = bench_flops_per_token(net, args)
     ok_tp = bench_continuous_vs_static(net, args)
-    if not (ok_flops and ok_tp):
+    ok_prefix = bench_prefix_cache(net, args)
+    ok_spec = bench_spec_decode(net, args)
+    if not (ok_flops and ok_tp and ok_prefix and ok_spec):
         sys.exit(1)
 
 

@@ -57,8 +57,13 @@ HOT_PATHS = {
     # inside the jitted decode trace (models.py raw bodies + the paged
     # attention kernel) must stay host-sync-free
     "serving/engine.py": {"step", "_admit", "_admit_one", "_ensure_blocks",
-                          "_emit", "_req_finished", "_finish", "_preempt"},
+                          "_emit", "_req_finished", "_finish", "_preempt",
+                          "_spec_step", "_spec_budgets", "_upload_tables",
+                          "_sync_prefix_counters"},
     "serving/models.py": None,
+    # prefix-cache bookkeeping (ISSUE 15): match/admit/prepare_write/
+    # ensure_capacity run on every admission and scheduler iteration
+    "serving/cache.py": None,
     "kernels/paged_attention.py": None,
     # io decode pipeline (ISSUE 7): the per-batch scheduler/collector core
     # and the worker decode body are the input-bound hot path

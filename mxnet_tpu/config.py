@@ -377,6 +377,28 @@ KNOWN_VARS = {
         "expired requests are evicted (queued or mid-decode) with "
         "RequestDeadlineExceeded — the serving twin of the resilience "
         "Deadline policy.  0 = no deadline; submit(deadline_s=) overrides."),
+    "MXNET_SERVING_PREFIX_CACHE": (
+        "0", int,
+        "If 1, the paged KV cache refcounts blocks and keeps a hash-keyed "
+        "prefix index over full blocks of prompt tokens: a prompt sharing "
+        "a cached prefix maps those blocks into its table (copy-on-write "
+        "on contended writes) and prefills only the tail — bit-identical "
+        "to the cold path, >= 2x fewer prefill positions on shared-"
+        "system-prompt traffic.  Decoder-only (llama) engines only."),
+    "MXNET_SERVING_DRAFT": (
+        None, str,
+        "Draft-model zoo config name for speculative decoding (e.g. "
+        "'llama_tiny'): the replica CLI and serve_bench build it with the "
+        "engine's vocab and seed so every replica speculates identically. "
+        " Unset (default) = speculation off.  In-process callers pass "
+        "ServingEngine(draft_model=) instead."),
+    "MXNET_SERVING_SPEC_K": (
+        "3", int,
+        "Draft tokens proposed per scheduler iteration when speculative "
+        "decoding is armed; the target verifies all of them (plus its "
+        "own fallback token) in ONE fixed-shape (B, K+1) dispatch — "
+        "accept-longest-prefix keeps output bit-identical to plain "
+        "greedy decode at any acceptance rate."),
     # serving router tier (ISSUE 13: serving.router + serving.replica —
     # the *_DIR/INDEX vars are WRITTEN by the router into each replica's
     # env, the rest tune the router process itself)
@@ -412,6 +434,14 @@ KNOWN_VARS = {
         "1", float,
         "Idle-load refresh interval: the router pings each replica this "
         "often so least-loaded dispatch stays fresh between acks."),
+    "MXNET_ROUTER_AFFINITY_TOKENS": (
+        "16", int,
+        "Prompt-prefix length (tokens) hashed for the router's prefix-"
+        "affinity dispatch hint: least-loaded TIES prefer the replica "
+        "that last served the same prefix hash, so shared-system-prompt "
+        "streams hit the per-replica paged-KV prefix cache (bounded "
+        "map; dead/busier replicas fall back to the rotating "
+        "tie-break).  0 disables the hint."),
     "MXNET_ROUTER_DIR": (
         None, str,
         "Router tier working directory (WRITTEN by the router into each "

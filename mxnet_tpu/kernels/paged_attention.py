@@ -36,7 +36,8 @@ which the new owner has overwritten.
 
 from __future__ import annotations
 
-__all__ = ["paged_attention", "write_kv", "write_kv_prefill", "SCRATCH_BLOCK"]
+__all__ = ["paged_attention", "paged_attention_multi", "write_kv",
+           "write_kv_multi", "write_kv_prefill", "SCRATCH_BLOCK"]
 
 # pool index reserved for discarded writes (inactive slots, pad positions)
 SCRATCH_BLOCK = 0
@@ -47,16 +48,12 @@ def _jnp():
     return jnp
 
 
-def paged_attention(q, k_pool, v_pool, block_table, ctx_len,
-                    num_kv_groups=1, sm_scale=None):
-    """Attention of ``q`` against the paged K/V of each sequence.
-
-    ``q`` is (B, H, Lq, D) — Lq is 1 on the decode path; ``ctx_len`` (B,)
-    counts readable positions (the caller writes the current token's k/v
-    FIRST, so ctx_len includes it).  GQA rides ``num_kv_groups`` = H /
-    kv_heads with the same head-major broadcast as
-    ``contrib.masked_att_qkv``.  Returns (B, H, Lq, D).
-    """
+def _paged_gather_attend(q, k_pool, v_pool, block_table, readable,
+                         num_kv_groups, sm_scale):
+    """Shared gather + masked-softmax core: ``readable`` is the (B, Lq)
+    per-query count of readable pool positions (same numerics discipline
+    as ``_dense_sdpa``: scores einsum in the input dtype, f32 softmax,
+    ``-1e9`` masking)."""
     import jax
     jnp = _jnp()
     B, H, Lq, D = q.shape
@@ -72,29 +69,101 @@ def paged_attention(q, k_pool, v_pool, block_table, ctx_len,
     scale = sm_scale if sm_scale is not None else 1.0 / float(D) ** 0.5
     att = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     pos = jnp.arange(S, dtype=jnp.int32)
-    mask = pos[None, None, None, :] < ctx_len[:, None, None, None]
+    mask = pos[None, None, None, :] < readable[:, None, :, None]
     att = jnp.where(mask, att, jnp.asarray(-1e9, jnp.float32))
     p = jax.nn.softmax(att, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def write_kv(k_pool, v_pool, block_table, pos, k_new, v_new):
+def paged_attention(q, k_pool, v_pool, block_table, ctx_len,
+                    num_kv_groups=1, sm_scale=None):
+    """Attention of ``q`` against the paged K/V of each sequence.
+
+    ``q`` is (B, H, Lq, D) — Lq is 1 on the decode path; ``ctx_len`` (B,)
+    counts readable positions (the caller writes the current token's k/v
+    FIRST, so ctx_len includes it).  GQA rides ``num_kv_groups`` = H /
+    kv_heads with the same head-major broadcast as
+    ``contrib.masked_att_qkv``.  Returns (B, H, Lq, D).
+    """
+    jnp = _jnp()
+    readable = jnp.broadcast_to(ctx_len[:, None],
+                                (q.shape[0], q.shape[2]))
+    return _paged_gather_attend(q, k_pool, v_pool, block_table, readable,
+                                num_kv_groups, sm_scale)
+
+
+def paged_attention_multi(q, k_pool, v_pool, block_table, pos0,
+                          num_kv_groups=1, sm_scale=None):
+    """Multi-query paged attention: query j of sequence b sits at
+    absolute position ``pos0[b] + j`` and attends every pool position
+    <= its own (causal within the chunk, full paged history before it).
+
+    ``q`` is (B, H, K, D) — the K-token speculative-verify / tail-prefill
+    chunk; the caller scatters the chunk's K/V FIRST (``write_kv_multi``)
+    so query j reads chunk keys 0..j through the pool like the 1-token
+    decode path reads its own freshly-written position.
+    """
+    jnp = _jnp()
+    K = q.shape[2]
+    readable = pos0[:, None] + jnp.arange(1, K + 1, dtype=pos0.dtype)[None]
+    return _paged_gather_attend(q, k_pool, v_pool, block_table, readable,
+                                num_kv_groups, sm_scale)
+
+
+def write_kv(k_pool, v_pool, block_table, pos, k_new, v_new, valid=None):
     """Scatter one token's k/v per sequence into its block-table slot.
 
     ``pos`` (B,) is the logical position being written (== ctx_len before
     the write); ``k_new``/``v_new`` are (B, KV, D).  Returns the updated
     pools.  Slots the scheduler parked on the scratch table all collide at
-    block 0 — by design, those writes are never read back.
+    block 0 — by design, those writes are never read back.  ``valid``
+    (B,) bool, when given, routes invalid rows' writes to the scratch
+    block instead — the draft model's over-the-budget speculation steps
+    must not scribble past a slot's reserved blocks.
     """
     jnp = _jnp()
     N, T, KV, D = k_pool.shape
-    B = pos.shape[0]
-    blk = jnp.take_along_axis(block_table, (pos // T)[:, None], axis=1)[:, 0]
+    MB = block_table.shape[1]
+    bi = pos // T
+    blk = jnp.take_along_axis(block_table, jnp.minimum(bi, MB - 1)[:, None],
+                              axis=1)[:, 0]
     idx = blk * T + pos % T                                   # (B,) flat
+    if valid is not None:
+        ok = valid & (bi < MB)
+        idx = jnp.where(ok, idx, SCRATCH_BLOCK * T + pos % T)
     k_pool = k_pool.reshape(N * T, KV, D).at[idx].set(k_new).reshape(
         N, T, KV, D)
     v_pool = v_pool.reshape(N * T, KV, D).at[idx].set(v_new).reshape(
         N, T, KV, D)
+    return k_pool, v_pool
+
+
+def write_kv_multi(k_pool, v_pool, block_table, pos0, n_valid,
+                   k_new, v_new):
+    """Scatter a K-token chunk's k/v per sequence (speculative verify /
+    prefix-cache tail prefill).
+
+    ``k_new``/``v_new`` are (B, K, KV, D) for positions ``pos0[b] + j``;
+    chunk columns ``j >= n_valid[b]`` (beyond the slot's remaining token
+    budget) and positions past the block table are routed to the scratch
+    block — written, never read, exactly like padded prefill positions.
+    Returns the updated pools.
+    """
+    jnp = _jnp()
+    N, T, KV, D = k_pool.shape
+    MB = block_table.shape[1]
+    B, K = k_new.shape[0], k_new.shape[1]
+    pos = pos0[:, None] + jnp.arange(K, dtype=pos0.dtype)[None]   # (B, K)
+    bi = pos // T
+    blk = jnp.take_along_axis(block_table, jnp.minimum(bi, MB - 1), axis=1)
+    ok = (jnp.arange(K, dtype=jnp.int32)[None] < n_valid[:, None]) \
+        & (bi < MB)
+    idx = jnp.where(ok, blk * T + pos % T, SCRATCH_BLOCK * T + pos % T)
+    idx = idx.reshape(B * K)
+    k_pool = k_pool.reshape(N * T, KV, D).at[idx].set(
+        k_new.reshape(B * K, KV, D)).reshape(N, T, KV, D)
+    v_pool = v_pool.reshape(N * T, KV, D).at[idx].set(
+        v_new.reshape(B * K, KV, D)).reshape(N, T, KV, D)
     return k_pool, v_pool
 
 

@@ -166,6 +166,21 @@ _NP_FUNCS = [
     "promote_types", "can_cast", "real", "imag", "conj", "conjugate", "angle",
     "i0", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "invert",
     "left_shift", "right_shift",
+    # delegated-surface round 6 (ISSUE 15 satellite): set ops, window
+    # functions, polynomial helpers, bit packing, the array-API aliases
+    # (concat/permute_dims/matrix_transpose/vecdot), and the apply/
+    # fromfunction functional constructors
+    "apply_along_axis", "apply_over_axes", "argpartition", "array_equiv",
+    "bartlett", "blackman", "hamming", "hanning", "kaiser",
+    "broadcast_shapes", "concat", "diagflat", "diag_indices_from",
+    "divmod", "frexp", "fromfunction", "geomspace", "histogram_bin_edges",
+    "histogramdd", "intersect1d", "isin", "iscomplexobj", "isrealobj",
+    "isscalar", "ix_", "lexsort", "matrix_transpose", "modf",
+    "nanpercentile", "nanquantile", "packbits", "unpackbits", "partition",
+    "permute_dims", "polyadd", "polyder", "polyint", "polymul", "polysub",
+    "polyval", "resize", "setdiff1d", "setxor1d", "sort_complex",
+    "spacing", "tril_indices_from", "triu_indices_from", "union1d",
+    "unwrap", "vander", "vecdot",
 ]
 
 _self = _sys.modules[__name__]
@@ -214,6 +229,21 @@ def _populate():
 
     shape.__doc__ = jnp.shape.__doc__
     _self.shape = shape
+    # jnp.mask_indices CALLS the user's mask_func on a jax array and
+    # feeds the result to jnp.nonzero — a delegated mx.np.triu/tril
+    # returns an NDArray there and jnp chokes on it (ISSUE 15 round-6
+    # catch).  Bind host-side with a shim that unwraps NDArray results,
+    # so the natural `mx.np.mask_indices(3, mx.np.triu)` spelling works.
+
+    def mask_indices(n, mask_func, k=0):
+        def _mf(a, kk):
+            out = mask_func(a, kk)
+            return out._data if isinstance(out, NDArray) else out
+        return tuple(NDArray._from_data(i, ctx=current_context())
+                     for i in jnp.mask_indices(n, _mf, k))
+
+    mask_indices.__doc__ = jnp.mask_indices.__doc__
+    _self.mask_indices = mask_indices
     # subnamespaces
     lin = _types.ModuleType(__name__ + ".linalg")
     import jax.numpy.linalg as jla

@@ -26,11 +26,21 @@ Quick start::
     eng.start()                      # background decode loop
     tokens = handle.result()
 
+Two serving-throughput levers ride the same substrate (ISSUE 15), both
+bit-identical to the plain paths: ``prefix_cache=True`` shares full
+prompt-prefix blocks across sequences (refcounts + copy-on-write + LRU
+eviction; only the tail prefills) and ``draft_model=``/``spec_k=`` arms
+draft-verify speculative decoding (one fixed-shape multi-token target
+dispatch verifies spec_k draft tokens, accept-longest-prefix).
+
 Knobs: ``MXNET_SERVING_BLOCK_TOKENS``, ``MXNET_SERVING_MAX_BATCH``,
 ``MXNET_SERVING_MAX_SEQ``, ``MXNET_SERVING_NUM_BLOCKS``,
-``MXNET_SERVING_PREFILL_TOKENS``, ``MXNET_SERVING_SLA_S`` (see README).
-Benchmark: ``benchmark/serve_bench.py`` (CI lane gates FLOPs/token and
-continuous-vs-static throughput).
+``MXNET_SERVING_PREFILL_TOKENS``, ``MXNET_SERVING_SLA_S``,
+``MXNET_SERVING_PREFIX_CACHE``, ``MXNET_SERVING_DRAFT``,
+``MXNET_SERVING_SPEC_K`` (see README).
+Benchmark: ``benchmark/serve_bench.py`` (CI lane gates FLOPs/token,
+continuous-vs-static throughput, prefix-cache prefill savings, and
+speculative tokens-per-dispatch).
 """
 
 from __future__ import annotations

@@ -101,6 +101,43 @@ _G_ACTIVE = _tel.gauge(
     "mxnet_serving_active_slots", "Decode slots currently serving.")
 _G_FREE_BLOCKS = _tel.gauge(
     "mxnet_serving_free_blocks", "KV pool blocks on the free list.")
+_M_PREFILL_POS = _tel.counter(
+    "mxnet_serving_prefill_positions_total",
+    "Token positions computed by PREFILL dispatches only (the full "
+    "padded shape cold, just the tail chunks on a prefix-cache hit) — "
+    "the numerator of the shared-prompt prefill-flops gate.")
+_M_PREFIX_HITS = _tel.counter(
+    "mxnet_serving_prefix_hits_total",
+    "Admissions that mapped >= 1 cached prefix block instead of "
+    "re-prefilling it (MXNET_SERVING_PREFIX_CACHE).")
+_M_PREFIX_TOKENS = _tel.counter(
+    "mxnet_serving_prefix_hit_tokens_total",
+    "Prompt token positions served from shared prefix blocks.")
+_M_PREFIX_EVICT = _tel.counter(
+    "mxnet_serving_prefix_evictions_total",
+    "Refcount-0 cached prefix blocks evicted (LRU) to satisfy "
+    "allocations under pool pressure.")
+_M_PREFIX_COW = _tel.counter(
+    "mxnet_serving_prefix_cow_total",
+    "Copy-on-write block duplications (a slot about to write a block "
+    "other sequences still map).")
+_G_CACHED_BLOCKS = _tel.gauge(
+    "mxnet_serving_prefix_cached_blocks",
+    "Refcount-0 blocks currently retained for prefix reuse "
+    "(evictable).")
+_M_DRAFT_STEPS = _tel.counter(
+    "mxnet_serving_draft_steps_total",
+    "Draft-model single-token dispatches (speculative decoding).")
+_M_DRAFT_POS = _tel.counter(
+    "mxnet_serving_draft_positions_total",
+    "Token positions computed by the DRAFT model (its prefills and "
+    "speculation steps) — FLOPs accounting: multiply by the draft "
+    "adapter's flops_per_position.")
+_H_ACCEPTED = _tel.histogram(
+    "mxnet_serving_accepted_draft_tokens",
+    "Draft tokens accepted per verify dispatch (emitted tokens minus "
+    "the target-sampled one) — the speculative-decoding acceptance "
+    "profile.", buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
 _H_TTFT = _tel.histogram(
     "mxnet_serving_ttft_seconds", "Submit -> first generated token.")
 _H_TPOT = _tel.histogram(
@@ -212,7 +249,8 @@ class ServingEngine:
 
     def __init__(self, model, eos_id=None, bos_id=None, max_batch=None,
                  block_tokens=None, max_seq=None, num_blocks=None,
-                 prefill_tokens=None, policy="continuous"):
+                 prefill_tokens=None, policy="continuous",
+                 prefix_cache=None, draft_model=None, spec_k=None):
         if policy not in ("continuous", "static"):
             raise MXNetError(f"policy {policy!r}: want continuous|static")
         self.policy = policy
@@ -249,9 +287,62 @@ class ServingEngine:
                 f"({limit} rows): decode positions past it would clamp "
                 f"and emit wrong tokens — lower MXNET_SERVING_MAX_SEQ or "
                 f"build the model with max_length >= {max_seq}")
+        if prefix_cache is None:
+            prefix_cache = bool(config.get_int(
+                "MXNET_SERVING_PREFIX_CACHE", 0))
+        self._prefix_on = bool(prefix_cache)
+        if self._prefix_on and not self.adapter.supports_prefix_cache:
+            raise MXNetError(
+                "prefix caching needs an adapter whose prompt K/V lives "
+                "in the pages (decoder-only llama); the encoder-decoder "
+                "adapter caches the source OUTSIDE the paged pool — "
+                "unset MXNET_SERVING_PREFIX_CACHE for this model")
         self.cache = PagedKVCache(self.max_batch, mbs, self.block_tokens,
-                                  num_blocks)
+                                  num_blocks, prefix_cache=self._prefix_on)
         self.adapter.make_pools(num_blocks, self.block_tokens)
+        # speculative decoding: a small same-family draft model proposes
+        # spec_k greedy tokens per iteration; ONE multi-token target
+        # dispatch verifies them (accept-longest-prefix + target-token
+        # fallback = bit-identical to plain greedy decode)
+        self._spec = None
+        self._spec_k = int(spec_k if spec_k is not None else
+                           config.get_int("MXNET_SERVING_SPEC_K", 3))
+        if draft_model is not None:
+            if self._spec_k < 1:
+                raise MXNetError("MXNET_SERVING_SPEC_K must be >= 1")
+            if not hasattr(self.adapter, "decode_multi"):
+                raise MXNetError(
+                    "speculative decoding needs a multi-token verify "
+                    "path (decoder-only llama adapter)")
+            if hasattr(draft_model, "decode") \
+                    and hasattr(draft_model, "prefill"):
+                draft = draft_model
+            else:
+                draft = make_adapter(draft_model, eos_id=eos_id,
+                                     bos_id=bos_id,
+                                     prefill_tokens=prefill_tokens,
+                                     max_batch=self.max_batch)
+            if not getattr(draft, "supports_prefix_cache", False):
+                raise MXNetError("draft model must be a decoder-only "
+                                 "(llama-family) zoo model")
+            dw = getattr(draft, "weights", None)
+            tw = getattr(self.adapter, "weights", None)
+            if dw is not None and tw is not None \
+                    and dw.embed.shape[0] != tw.embed.shape[0]:
+                raise MXNetError(
+                    f"draft vocab {dw.embed.shape[0]} != target vocab "
+                    f"{tw.embed.shape[0]}: draft proposals could never "
+                    "be verified token-for-token")
+            draft.make_pools(num_blocks, self.block_tokens)
+            self._spec = draft
+        self._adapters = [self.adapter] + \
+            ([self._spec] if self._spec is not None else [])
+        # prefix-counter sync marks (cache mutates its own tallies; the
+        # scheduler folds the deltas into telemetry once per iteration)
+        self._seen_evictions = 0
+        self._seen_cow = 0
+        self._seen_hits = 0
+        self._seen_hit_tokens = 0
         self.default_sla_s = config.get_float("MXNET_SERVING_SLA_S", 0.0)
         self._lock = threading.Lock()      # queue + slots + cache
         self._queue = collections.deque()
@@ -416,27 +507,65 @@ class ServingEngine:
 
     def _admit_one(self, req, slot_idx):
         """Prefill one request into a free slot.  Raises CacheOOMError
-        with nothing mutated if the pool can't cover the reservation."""
+        with nothing mutated if the pool can't cover the reservation.
+
+        With prefix caching on, full blocks of the prompt found in the
+        index map straight into the slot's table and only the tail
+        re-prefills (fixed block-sized chunks through the multi-token
+        paged path); when the index covers the WHOLE prompt, the last
+        token re-scores through a one-block chunk whose write triggers
+        copy-on-write if another sequence still maps that block."""
         now = time.perf_counter()
         if self.adapter.supports_recompute:
             prompt = self._recompute_prompt(req)
         else:
             prompt = req.prompt
-        self.cache.admit(slot_idx, self._admissible(req))
+        hit0 = self.cache.prefix_hit_tokens
+        if self._prefix_on:
+            self.cache.admit(slot_idx, self._admissible(req), prompt)
+        else:
+            self.cache.admit(slot_idx, self._admissible(req))
+        shared = self.cache.prefix_hit_tokens - hit0
         _H_QWAIT.observe(now - req.queued_t)
         _ttrace.async_event("admitted", "serving.request", "n", req.rid,
                             slot=slot_idx)
         try:
-            with _tel.span("serving.prefill", "serving", rid=req.rid):
-                first = self.adapter.prefill(slot_idx, prompt,
-                                             self.cache.tables[slot_idx])
+            with _tel.span("serving.prefill", "serving", rid=req.rid,
+                           shared_tokens=shared):
+                if shared:
+                    # a fully-covered prompt still needs its last
+                    # position's logits for the first generated token
+                    tail = shared if shared < len(prompt) \
+                        else len(prompt) - 1
+                    for src, dst in self.cache.prepare_write(slot_idx,
+                                                             tail):
+                        for ad in self._adapters:
+                            ad.copy_block(dst, src)
+                    row = self.cache.tables[slot_idx]
+                    first, pos = self.adapter.prefill_tail(
+                        slot_idx, prompt, tail, row)
+                    _M_POSITIONS.inc(pos)
+                    _M_PREFILL_POS.inc(pos)
+                    if self._spec is not None:
+                        _t, dpos = self._spec.prefill_tail(
+                            slot_idx, prompt, tail, row)
+                        _M_DRAFT_POS.inc(dpos)
+                else:
+                    first = self.adapter.prefill(
+                        slot_idx, prompt, self.cache.tables[slot_idx])
+                    _M_POSITIONS.inc(self.adapter.prefill_tokens)
+                    _M_PREFILL_POS.inc(self.adapter.prefill_tokens)
+                    if self._spec is not None:
+                        self._spec.prefill(slot_idx, prompt,
+                                           self.cache.tables[slot_idx])
+                        _M_DRAFT_POS.inc(self._spec.prefill_tokens)
         except Exception:
             # the blocks claimed above must not leak with the slot empty —
             # a poisoned slot would crash every later admission into it
             self.cache.release(slot_idx)
             raise
+        self.cache.register_prefix(slot_idx, prompt)
         _M_PREFILLS.inc()
-        _M_POSITIONS.inc(self.adapter.prefill_tokens)
         _M_ADMITTED.inc()
         if self.adapter.first_token_from_prefill:
             # prompt tokens (incl. recomputed generations) now sit in
@@ -493,14 +622,16 @@ class ServingEngine:
                 continue
             free.pop(0)
 
-    def _ensure_blocks(self, now):
-        """Every active slot's next write position gets a block;
+    def _ensure_blocks(self, now, want=None):
+        """Every active slot's next write position gets a block (``want``
+        = per-slot position count, e.g. the speculative chunk width);
         pool pressure preempts the youngest recompute-capable slot."""
         del now
         for i in range(self.max_batch):
             while self._slots[i] is not None:
                 try:
-                    self.cache.ensure_capacity(i)
+                    self.cache.ensure_capacity(
+                        i, 1 if want is None else int(want[i]))
                     break
                 except CacheOOMError as oom:
                     victims = sorted(
@@ -516,6 +647,105 @@ class ServingEngine:
                     self._preempt(victims[-1])
                     # if i preempted itself the outer while exits below
 
+    def _upload_tables(self):
+        if self._tables_version != self.cache.version:
+            # tables only change at admission/allocation/release —
+            # the steady-state iteration skips this upload
+            import jax.numpy as jnp
+            self._tables_dev = jnp.asarray(self.cache.tables)
+            self._tables_version = self.cache.version
+
+    def _spec_budgets(self):
+        """Per-slot speculative chunk width: how many positions this
+        iteration may write/emit — the verify width capped by the
+        request's remaining token budget (a slot on its last token runs
+        a 1-valid-column verify, exactly the plain decode)."""
+        want = np.zeros((self.max_batch,), np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                want[i] = min(self._spec_k + 1,
+                              slot.req.max_new_tokens
+                              - len(slot.req.outputs))
+        return want
+
+    def _spec_step(self, active, n_valid):
+        """One speculative iteration (lock held): spec_k draft-model
+        single-token steps propose greedy continuations, ONE (B, K)
+        target verify scores every column, and accept-longest-prefix +
+        the target's own token keeps output bit-identical to plain
+        greedy decode."""
+        B, K = self.max_batch, self._spec_k + 1
+        tokens = np.zeros((B, K), np.int32)
+        for i in active:
+            tokens[i, 0] = self._slots[i].last_token
+        self._upload_tables()
+        ctx = self.cache.ctx_len
+        cur = tokens[:, 0].copy()
+        for j in range(K - 1):
+            # draft writes its own pools at ctx+j; over-budget columns
+            # route to scratch (valid mask) so speculation can never
+            # scribble past a slot's reserved blocks
+            cur = np.asarray(self._spec.decode(
+                cur, self._tables_dev, ctx + j,
+                valid=(j < n_valid)), np.int32)
+            tokens[:, j + 1] = cur
+            _M_DRAFT_STEPS.inc()
+            _M_DRAFT_POS.inc(B)
+        sp = _tel.span("serving.decode_step", "serving",
+                       batch=len(active), spec_k=K - 1)
+        if sp is not _tel.NULL_SPAN:
+            sp.set(rids=[self._slots[i].req.rid for i in active])
+        with sp:
+            g = self.adapter.decode_multi(tokens, self._tables_dev, ctx,
+                                          n_valid)
+        _M_STEPS.inc()
+        _M_POSITIONS.inc(B * K)
+        now = time.perf_counter()
+        for i in active:
+            slot = self._slots[i]
+            if slot is None:
+                continue              # preempted under pressure
+            nv = int(n_valid[i])
+            # column j's argmax is the target's next token after
+            # consuming (t0, d1..dj); drafts are accepted while they
+            # match it, then the target's own token closes the run
+            emitted = [int(g[i, 0])]
+            j = 0
+            while j + 1 < nv and int(tokens[i, j + 1]) == emitted[-1]:
+                j += 1
+                emitted.append(int(g[i, j]))
+            for e, tok in enumerate(emitted):
+                if tok == self.eos_id:
+                    emitted = emitted[:e + 1]
+                    break
+            _H_ACCEPTED.observe(len(emitted) - 1)
+            self.cache.advance(i, len(emitted))
+            slot.last_token = emitted[-1]
+            for tok in emitted:
+                self._emit(slot.req, tok, now)
+            if self._req_finished(slot.req):
+                self._finish(i)
+
+    def _sync_prefix_counters(self):
+        """Fold the cache's own tallies into telemetry (once per
+        iteration — the cache stays import-light and jax/telemetry
+        free)."""
+        c = self.cache
+        if c.evictions != self._seen_evictions:
+            _M_PREFIX_EVICT.inc(c.evictions - self._seen_evictions)
+            self._seen_evictions = c.evictions
+        if c.cow_copies != self._seen_cow:
+            _M_PREFIX_COW.inc(c.cow_copies - self._seen_cow)
+            self._seen_cow = c.cow_copies
+        if c.prefix_hits != self._seen_hits:
+            _M_PREFIX_HITS.inc(c.prefix_hits - self._seen_hits)
+            self._seen_hits = c.prefix_hits
+        if c.prefix_hit_tokens != self._seen_hit_tokens:
+            _M_PREFIX_TOKENS.inc(
+                c.prefix_hit_tokens - self._seen_hit_tokens)
+            self._seen_hit_tokens = c.prefix_hit_tokens
+        _G_CACHED_BLOCKS.set(c.cached_blocks)
+
     def step(self):
         """One scheduler iteration (expire → backfill → decode → retire).
         Returns True when any work was done — the background loop idles
@@ -530,19 +760,19 @@ class ServingEngine:
                     self.cache.release(i)
                     self._evict(req, "decoding")
             self._admit(now)
-            self._ensure_blocks(now)
+            want = None if self._spec is None else self._spec_budgets()
+            self._ensure_blocks(now, want)
             active = [i for i, s in enumerate(self._slots) if s is not None]
             did_work = bool(active)
-            if active:
+            if active and self._spec is not None:
+                # the dispatches run under self._lock for the same
+                # reason as the plain decode below
+                self._spec_step(active, want)
+            elif active:
                 tokens = np.zeros((self.max_batch,), np.int32)
                 for i in active:
                     tokens[i] = self._slots[i].last_token
-                if self._tables_version != self.cache.version:
-                    # tables only change at admission/allocation/release —
-                    # the steady-state iteration skips this upload
-                    import jax.numpy as jnp
-                    self._tables_dev = jnp.asarray(self.cache.tables)
-                    self._tables_version = self.cache.version
+                self._upload_tables()
                 # the dispatch runs under self._lock on purpose: released,
                 # a finished slot could be backfilled mid-dispatch and this
                 # step's tokens credited to the wrong request (lock-free
@@ -572,6 +802,8 @@ class ServingEngine:
             _G_QUEUE.set(len(self._queue))
             _G_ACTIVE.set(sum(s is not None for s in self._slots))
             _G_FREE_BLOCKS.set(self.cache.free_blocks)
+            if self._prefix_on:
+                self._sync_prefix_counters()
             return did_work or bool(self._queue)
 
     # -- driving ------------------------------------------------------------
@@ -649,3 +881,5 @@ class ServingEngine:
             _G_QUEUE.set(0)
             _G_ACTIVE.set(0)
             _G_FREE_BLOCKS.set(self.cache.free_blocks)
+            if self._prefix_on:
+                self._sync_prefix_counters()

@@ -104,6 +104,22 @@ def _rope_at(x, pos, base):
                            axis=-1)
 
 
+def _rope_at_multi(x, pos, base):
+    """llama._rope on (B, H, K, D) at per-(sequence, column) positions
+    ``pos`` (B, K) — the K-token verify/tail-chunk generalization of
+    :func:`_rope_at` (K=1 reduces to it exactly)."""
+    jnp = _jnp()
+    B, _, K, D = x.shape
+    half = D // 2
+    ang = _rope_angles(pos.reshape(-1).astype(jnp.float32), half, base)
+    ang = ang.reshape(B, K, half)
+    cos = jnp.cos(ang)[:, None, :, :].astype(x.dtype)         # (B, 1, K, h)
+    sin = jnp.sin(ang)[:, None, :, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                           axis=-1)
+
+
 def _heads(x, n, hd):
     """(B, L, n*hd) -> (B, n, L, hd)."""
     B, L = x.shape[0], x.shape[1]
@@ -150,9 +166,12 @@ def _llama_qkv(cfg, bw, x):
     return q, k, v
 
 
-def _llama_decode_raw(cfg, w, kv, tokens, tables, ctx):
+def _llama_decode_raw(cfg, w, kv, tokens, tables, ctx, valid):
     """One continuous-batching iteration: tokens (B,) int32 at positions
-    ``ctx`` (B,) -> next tokens (B,).  Reads/writes the paged pools."""
+    ``ctx`` (B,) -> next tokens (B,).  Reads/writes the paged pools;
+    ``valid`` (B,) bool routes over-budget rows' k/v writes to scratch
+    (always all-true on the target decode path — the draft model's
+    speculation steps are the masked caller)."""
     jnp = _jnp()
     scale = 1.0 / float(cfg.head_dim) ** 0.5
     groups = cfg.heads // cfg.kv_heads
@@ -165,7 +184,7 @@ def _llama_decode_raw(cfg, w, kv, tokens, tables, ctx):
         q = _rope_at(q, ctx, cfg.rope_base)
         k = _rope_at(k, ctx, cfg.rope_base)
         kp, vp = _pa.write_kv(kp, vp, tables, ctx,
-                              k[:, :, 0, :], v[:, :, 0, :])
+                              k[:, :, 0, :], v[:, :, 0, :], valid=valid)
         att = _pa.paged_attention(q, kp, vp, tables, ctx + 1,
                                   num_kv_groups=groups, sm_scale=scale)
         x = _llama_layer(cfg, bw, x, att)
@@ -174,6 +193,52 @@ def _llama_decode_raw(cfg, w, kv, tokens, tables, ctx):
     logits = jnp.matmul(xf[:, 0], w.lm_head.T)               # (B, V)
     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return tuple(new_kv), nxt, logits
+
+
+def _llama_multi_decode_raw(cfg, w, kv, tokens, tables, pos0, n_valid):
+    """K tokens per slot in ONE dispatch — the speculative-verify /
+    prefix-tail-chunk body.  ``tokens`` (B, K) int32 sit at positions
+    ``pos0[b] + j``; their k/v scatters into the pages first (columns
+    past ``n_valid[b]`` -> scratch), then every column attends its own
+    causal bound through the pool, so column j's logits are exactly what
+    a j-step sequential decode would have produced.  Returns the greedy
+    argmax per column (B, K) — all the accept-longest-prefix rule needs.
+    """
+    jnp = _jnp()
+    scale = 1.0 / float(cfg.head_dim) ** 0.5
+    groups = cfg.heads // cfg.kv_heads
+    B, K = tokens.shape
+    pos = pos0[:, None] + jnp.arange(K, dtype=pos0.dtype)[None]  # (B, K)
+    x = jnp.take(w.embed, tokens, axis=0)                    # (B, K, C)
+    new_kv = []
+    for li in range(cfg.layers):
+        bw = w.blocks[li]
+        kp, vp = kv[li]
+        q, k, v = _llama_qkv(cfg, bw, x)
+        q = _rope_at_multi(q, pos, cfg.rope_base)
+        k = _rope_at_multi(k, pos, cfg.rope_base)
+        kp, vp = _pa.write_kv_multi(kp, vp, tables, pos0, n_valid,
+                                    k.transpose(0, 2, 1, 3),
+                                    v.transpose(0, 2, 1, 3))
+        att = _pa.paged_attention_multi(q, kp, vp, tables, pos0,
+                                        num_kv_groups=groups,
+                                        sm_scale=scale)
+        x = _llama_layer(cfg, bw, x, att)
+        new_kv.append((kp, vp))
+    xf = _rms(x, w.norm, cfg.eps)
+    logits = jnp.matmul(xf, w.lm_head.T)                     # (B, K, V)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # (B, K)
+    return tuple(new_kv), nxt
+
+
+def _copy_block_raw(kv, src, dst):
+    """Device-side block copy for copy-on-write: every layer's k/v pool
+    row ``dst`` becomes a copy of row ``src`` (pools donated — steady
+    state allocates nothing)."""
+    out = []
+    for kp, vp in kv:
+        out.append((kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src])))
+    return tuple(out)
 
 
 def _llama_prefill_raw(cfg, w, kv, tokens, plen, table_row):
@@ -320,6 +385,12 @@ def _jitted():
         _JIT["llama_decode"] = _cm.wrap_jit(
             jax.jit(_llama_decode_raw, static_argnums=0, donate_argnums=2),
             "serving.llama_decode")
+        _JIT["llama_multi"] = _cm.wrap_jit(
+            jax.jit(_llama_multi_decode_raw, static_argnums=0,
+                    donate_argnums=2), "serving.llama_multi")
+        _JIT["llama_copy_block"] = _cm.wrap_jit(
+            jax.jit(_copy_block_raw, donate_argnums=0),
+            "serving.llama_copy_block")
         _JIT["llama_prefill"] = _cm.wrap_jit(
             jax.jit(_llama_prefill_raw, static_argnums=0,
                     donate_argnums=2), "serving.llama_prefill")
@@ -344,6 +415,10 @@ class _AdapterBase:
 
     first_token_from_prefill = False
     supports_recompute = False
+    # prompt K/V lives in the pages AND the adapter can score/write a
+    # multi-token chunk against them — what prefix-cache block sharing
+    # (tail-only prefill) and speculative verify both require
+    supports_prefix_cache = False
     # hard ceiling on cache positions the model can embed (None = no
     # table, e.g. RoPE); the engine refuses a max_seq beyond it — decode
     # positions past a sinusoid table would CLAMP (jnp.take) and emit
@@ -355,12 +430,15 @@ class _AdapterBase:
         self.eos_id = int(eos_id)
         self.bos_id = None if bos_id is None else int(bos_id)
         self._kv = None
+        self._block_tokens = None
+        self._all_valid = None
 
     def _pool_shape(self, num_blocks, block_tokens):
         raise NotImplementedError
 
     def make_pools(self, num_blocks, block_tokens):
         jnp = _jnp()
+        self._block_tokens = int(block_tokens)
         shape = self._pool_shape(num_blocks, block_tokens)
         self._kv = tuple(
             (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
@@ -394,6 +472,7 @@ class LlamaServingAdapter(_AdapterBase):
 
     first_token_from_prefill = True
     supports_recompute = True
+    supports_prefix_cache = True
 
     def __init__(self, model, eos_id, prefill_tokens):
         super().__init__(prefill_tokens, eos_id, None)
@@ -448,12 +527,68 @@ class LlamaServingAdapter(_AdapterBase):
             self.cfg, self.weights, self._kv, toks, plen, row)
         return int(nxt)
 
-    def decode(self, tokens, tables, ctx):
+    def prefill_tail(self, slot, prompt, tail_start, table_row):
+        """Prefix-cache-hit admission: positions < ``tail_start`` already
+        sit in blocks shared from the prefix index, so only the tail
+        re-computes — in fixed ``(1, block_tokens)`` chunks from the
+        containing block boundary (the boundary chunk re-writes its
+        already-correct shared positions bit-identically into the slot's
+        COW'd copy).  Returns (first generated token, positions computed)
+        — the second is what the prefill-flops telemetry counts instead
+        of the full padded prefill shape."""
+        del slot
         jnp = _jnp()
+        T = self._block_tokens
+        plen = len(prompt)
+        base = (int(tail_start) // T) * T
+        row = np.zeros((1, len(table_row)), np.int32)
+        row[0] = np.asarray(table_row, np.int32)
+        row = jnp.asarray(row)
+        nxt = None
+        positions = 0
+        for lo in range(base, plen, T):
+            chunk = np.zeros((1, T), np.int32)
+            nv = min(T, plen - lo)
+            chunk[0, :nv] = prompt[lo:lo + nv]
+            self._kv, g = _jitted()["llama_multi"](
+                self.cfg, self.weights, self._kv, jnp.asarray(chunk), row,
+                jnp.asarray(np.array([lo], np.int32)),
+                jnp.asarray(np.array([nv], np.int32)))
+            nxt = int(np.asarray(g)[0, nv - 1])
+            positions += T
+        return nxt, positions
+
+    def decode(self, tokens, tables, ctx, valid=None):
+        jnp = _jnp()
+        if valid is None:
+            if self._all_valid is None \
+                    or len(self._all_valid) != len(tokens):
+                self._all_valid = np.ones((len(tokens),), bool)
+            valid = self._all_valid
         self._kv, nxt, _ = _jitted()["llama_decode"](
             self.cfg, self.weights, self._kv,
-            jnp.asarray(tokens), jnp.asarray(tables), jnp.asarray(ctx))
+            jnp.asarray(tokens), jnp.asarray(tables), jnp.asarray(ctx),
+            jnp.asarray(valid))
         return np.asarray(nxt)
+
+    def decode_multi(self, tokens, tables, ctx, n_valid):
+        """One (B, K) speculative-verify dispatch: greedy argmax per
+        chunk column (B, K) int32."""
+        jnp = _jnp()
+        self._kv, g = _jitted()["llama_multi"](
+            self.cfg, self.weights, self._kv,
+            jnp.asarray(np.asarray(tokens, np.int32)), jnp.asarray(tables),
+            jnp.asarray(np.asarray(ctx, np.int32)),
+            jnp.asarray(np.asarray(n_valid, np.int32)))
+        return np.asarray(g)
+
+    def copy_block(self, dst, src):
+        """COW: duplicate pool block ``src`` into ``dst`` in every
+        layer's k/v pools."""
+        jnp = _jnp()
+        self._kv = _jitted()["llama_copy_block"](
+            self._kv, jnp.asarray(np.int32(src)),
+            jnp.asarray(np.int32(dst)))
 
 
 class TransformerServingAdapter(_AdapterBase):

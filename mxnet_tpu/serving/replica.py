@@ -382,21 +382,32 @@ class ReplicaServer:
 def _build_engine(args):
     """Deterministic llama build: every replica spawned with the same
     (model, vocab, seed) holds bit-identical weights, which is what makes
-    a retried request's re-prefill on a survivor token-identical."""
+    a retried request's re-prefill on a survivor token-identical.  The
+    draft model (speculative decoding, ``--draft`` /
+    ``MXNET_SERVING_DRAFT``) builds the same way from its own zoo config
+    name — same seed, same vocab — so every replica speculates
+    identically too."""
     import numpy as np
     import mxnet_tpu as mx
     from ..gluon.model_zoo import llama
     from .engine import ServingEngine
 
-    mx.random.seed(args.seed)
-    np.random.seed(args.seed)
-    net = llama.llama_model(args.model, vocab_size=args.vocab)
-    net.initialize(mx.initializer.Normal(0.05))
-    net(mx.nd.array(np.zeros((1, 4), np.int32)))    # finish deferred init
+    def build(name):
+        mx.random.seed(args.seed)
+        np.random.seed(args.seed)
+        net = llama.llama_model(name, vocab_size=args.vocab)
+        net.initialize(mx.initializer.Normal(0.05))
+        net(mx.nd.array(np.zeros((1, 4), np.int32)))  # finish deferred init
+        return net
+
+    net = build(args.model)
+    draft = build(args.draft) if args.draft else None
     eng = ServingEngine(
         net, eos_id=args.eos, max_batch=args.max_batch,
         block_tokens=args.block_tokens, max_seq=args.max_seq,
-        prefill_tokens=args.prefill_tokens)
+        prefill_tokens=args.prefill_tokens,
+        prefix_cache=args.prefix_cache, draft_model=draft,
+        spec_k=args.spec_k)
     eng.start()
     return eng
 
@@ -416,6 +427,15 @@ def main(argv=None):
     ap.add_argument("--block-tokens", type=int, default=None)
     ap.add_argument("--max-seq", type=int, default=None)
     ap.add_argument("--prefill-tokens", type=int, default=None)
+    ap.add_argument("--draft", default=config.get("MXNET_SERVING_DRAFT"),
+                    help="draft-model zoo config for speculative decoding "
+                         "(MXNET_SERVING_DRAFT; unset = off)")
+    ap.add_argument("--spec-k", type=int, default=None,
+                    help="draft tokens per iteration (MXNET_SERVING_SPEC_K)")
+    ap.add_argument("--prefix-cache", type=int,
+                    default=config.get_int("MXNET_SERVING_PREFIX_CACHE", 0),
+                    help="1 arms paged-KV prefix caching "
+                         "(MXNET_SERVING_PREFIX_CACHE)")
     args = ap.parse_args(argv)
     if not args.workdir:
         raise MXNetError("replica worker needs --workdir "

@@ -12,7 +12,11 @@ Dispatch
     ``(queue_depth, active_slots, free_blocks)`` triple (the same gauges
     the PR 11 ``/metrics`` plane exports), and idle replicas are pinged
     every ``MXNET_ROUTER_PING_S`` so the view stays fresh.  Ties break
-    by index (deterministic tests).
+    by a rotating index (deterministic tests), except that a request
+    whose prompt-prefix hash (``MXNET_ROUTER_AFFINITY_TOKENS``) was
+    recently served prefers that replica — the tier-level half of
+    prefix caching: the replica holding those paged-KV blocks gets the
+    request, a busier or dead replica falls back to the rotation.
 
 Admission
     Outstanding requests (queued + dispatched, unfinished) are bounded
@@ -67,6 +71,7 @@ up even when the accelerator stack cannot.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import signal
@@ -91,6 +96,10 @@ __all__ = ["Router", "RouterHandle", "RouterOverloaded",
 
 STATE_FILE = "router.json"
 STATE_VERSION = 1
+# bound on the prefix-affinity map (prefix-hash -> last replica): beyond
+# it the least-recently-dispatched prefixes age out — a stale or evicted
+# entry only costs one cold prefill on another replica, never correctness
+AFFINITY_MAP = 512
 
 
 class RouterOverloaded(ServingError):
@@ -154,7 +163,7 @@ class _Req:
     __slots__ = ("rid", "tag", "prompt", "max_new_tokens", "deadline_s",
                  "submit_wall", "submit_t", "done", "tokens", "error",
                  "dispatches", "retries", "hedged", "finish_t",
-                 "last_dispatch_t")
+                 "last_dispatch_t", "affinity")
 
     def __init__(self, rid, tag, prompt, max_new_tokens, deadline_s,
                  submit_wall=None):
@@ -174,6 +183,7 @@ class _Req:
         self.hedged = False
         self.finish_t = None
         self.last_dispatch_t = None
+        self.affinity = None         # prompt-prefix hash (router sets it)
 
     def remaining_s(self):
         """Remaining deadline budget (None = unbounded) measured on the
@@ -287,7 +297,8 @@ class Router:
                  hedge_s=None, max_retries=None, max_respawns=None,
                  hang_s=None, ping_s=None, grace_s=3.0,
                  spawn_timeout_s=240.0, env_extra=None,
-                 env_per_replica=None, poll_s=0.05):
+                 env_per_replica=None, poll_s=0.05,
+                 affinity_tokens=None):
         if not command:
             raise MXNetError("router needs a replica worker command")
         self._command = [str(c) for c in command]
@@ -313,6 +324,14 @@ class Router:
         self._env_per_replica = {int(k): dict(v) for k, v in
                                  (env_per_replica or {}).items()}
         self._poll_s = float(poll_s)
+        # prefix-affinity dispatch hint: least-loaded TIES prefer the
+        # replica that last served the same prompt-prefix hash, so a
+        # shared-system-prompt workload actually lands on the replica
+        # whose paged-KV prefix cache holds those blocks
+        self._affinity_tokens = affinity_tokens if affinity_tokens \
+            is not None else config.get_int(
+                "MXNET_ROUTER_AFFINITY_TOKENS", 16)
+        self._affinity = collections.OrderedDict()  # hash -> replica idx
         self._backoff = Retry(site="router.respawn")
 
         self._lock = threading.Lock()
@@ -443,6 +462,7 @@ class Router:
                        rec.get("max_new_tokens", 32),
                        rec.get("deadline_s"),
                        submit_wall=rec.get("submit_wall"))
+            req.affinity = self._affinity_key(req.prompt)
             self._requests[req.rid] = req  # graftcheck: ignore[GC04] — _recover runs inside start()'s with-self._lock block before any worker thread exists
             self._queue.append(req)
             self._recovered[req.tag] = RouterHandle(req)
@@ -541,6 +561,7 @@ class Router:
             self._rid_n += 1
             req = _Req(f"{self._rid_salt}-{self._rid_n}", tag, prompt,
                        max_new_tokens, deadline_s)
+            req.affinity = self._affinity_key(req.prompt)
             _ttrace.async_event("request", "router.request", "b", req.rid,
                                 prompt_tokens=len(req.prompt),
                                 max_new_tokens=req.max_new_tokens)
@@ -747,20 +768,46 @@ class Router:
 
     # -- dispatch -----------------------------------------------------------
 
-    def _pick_replica(self):
+    def _affinity_key(self, prompt):
+        """Prompt-prefix hash for affinity dispatch (first
+        MXNET_ROUTER_AFFINITY_TOKENS tokens; None = hint disabled).  A
+        hash collision costs at worst one sub-optimal pick."""
+        if self._affinity_tokens <= 0 or not prompt:
+            return None
+        return hash(tuple(prompt[:self._affinity_tokens]))
+
+    def _pick_replica(self, req=None):
         """Least-loaded up replica (lock held), or None.  Ties break on
         a ROTATING index (still deterministic): a fixed lowest-index
         tie-break sends every 4th request of a striped workload to the
         same replica — the serve_bench mixed workload put ALL its
         long-tail generations on replica 0 that way and halved the
-        scale-out ratio."""
+        scale-out ratio.  PREFIX AFFINITY overrides the rotation (never
+        the load ranking): among equally-loaded replicas, the one that
+        last served this prompt-prefix hash wins, so a shared-system-
+        prompt stream actually hits the per-replica paged-KV prefix
+        cache instead of striping across the tier; a dead or busier
+        remembered replica falls back to the plain tie-break."""
         live = [r for r in self._replicas if r.state == "up"]
         if not live:
             return None
         rr = self._rr
         self._rr += 1
-        return min(live, key=lambda r: (r.load_key(),
+        best = min(live, key=lambda r: (r.load_key(),
                                         (r.index - rr) % self._n))
+        key = None if req is None else req.affinity
+        if key is not None:
+            want = self._affinity.get(key)
+            if want is not None and want != best.index:
+                cand = self._replicas[want]
+                if cand.state == "up" \
+                        and cand.load_key() == best.load_key():
+                    best = cand
+            self._affinity[key] = best.index
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > AFFINITY_MAP:
+                self._affinity.popitem(last=False)
+        return best
 
     def _record_dispatch(self, req, rep, kind):
         """Record one dispatch (journal-first) and return its wire
@@ -842,7 +889,7 @@ class Router:
                                 "dispatch"))
                     continue
                 with self._lock:
-                    rep = self._pick_replica()
+                    rep = self._pick_replica(req)
                 if rep is None:
                     stalled = batch[i:]
                     break

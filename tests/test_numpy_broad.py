@@ -926,6 +926,180 @@ EXT_FNS5 = [
 ]
 
 
+# ---------------------------------------------------------------------------
+# delegated-surface parity extension round 6 (ISSUE 15 satellite): the
+# ~50-function slice that closes the set-operation / window-function /
+# polynomial / bit-packing families plus the numpy-2 array-API aliases
+# (concat, permute_dims, matrix_transpose, vecdot) and the functional
+# constructors (fromfunction, apply_along_axis/over_axes) — thin jnp
+# delegation where result dtypes (bool/int asserts below), tuple-of-array
+# returns (divmod/frexp/modf/ix_/indices-from), python-scalar returns
+# (isscalar, broadcast_shapes) and CALLBACK arguments (mask_indices takes
+# a mask_func — the delegated mx.np.triu returning NDArray into jnp was
+# this round's delegation catch, now unwrapped host-side) could silently
+# diverge.
+# ---------------------------------------------------------------------------
+
+EXT_FNS6 = [
+    ("apply_along_axis",
+     lambda m, x: m.apply_along_axis(lambda v: v.sum(), 1, m.array(x)),
+     lambda x: onp.apply_along_axis(lambda v: v.sum(), 1, x)),
+    ("apply_over_axes",
+     lambda m, x: m.apply_over_axes(
+         lambda a, ax: a.sum(ax, keepdims=True), m.array(x), [0]),
+     lambda x: onp.apply_over_axes(
+         lambda a, ax: a.sum(ax, keepdims=True), x, [0])),
+    ("argpartition",
+     lambda m, x: m.sort(m.argpartition(m.array(x[0]), 2)[:3]),
+     lambda x: onp.sort(onp.argpartition(x[0], 2)[:3])),
+    ("array_equiv", lambda m, x: m.array_equiv(m.array(x), m.array(x)),
+     lambda x: onp.array_equiv(x, x)),
+    ("bartlett", lambda m, x: m.bartlett(7), lambda x: onp.bartlett(7)),
+    ("blackman", lambda m, x: m.blackman(7), lambda x: onp.blackman(7)),
+    ("hamming", lambda m, x: m.hamming(7), lambda x: onp.hamming(7)),
+    ("hanning", lambda m, x: m.hanning(7), lambda x: onp.hanning(7)),
+    ("kaiser", lambda m, x: m.kaiser(7, 8.6),
+     lambda x: onp.kaiser(7, 8.6)),
+    ("broadcast_shapes",
+     lambda m, x: onp.array(m.broadcast_shapes((3, 1), (1, 4))),
+     lambda x: onp.array(onp.broadcast_shapes((3, 1), (1, 4)))),
+    ("concat", lambda m, x: m.concat([m.array(x), m.array(x)]),
+     lambda x: onp.concatenate([x, x])),
+    ("diagflat", lambda m, x: m.diagflat(m.array(x[0, :3])),
+     lambda x: onp.diagflat(x[0, :3])),
+    ("diag_indices_from",
+     lambda m, x: m.diag_indices_from(m.array(x[:4, :4]))[0],
+     lambda x: onp.diag_indices_from(x[:4, :4])[0]),
+    ("divmod", lambda m, x: m.divmod(m.array(_xi()), 3)[1],
+     lambda x: onp.divmod(_xi(), 3)[1]),
+    ("frexp", lambda m, x: m.frexp(m.array(x))[0],
+     lambda x: onp.frexp(x)[0]),
+    ("fromfunction",
+     lambda m, x: m.fromfunction(lambda i, j: i + j, (3, 3)),
+     lambda x: onp.fromfunction(lambda i, j: i + j, (3, 3))),
+    ("geomspace", lambda m, x: m.geomspace(1.0, 64.0, 7),
+     lambda x: onp.geomspace(1.0, 64.0, 7)),
+    ("histogram_bin_edges",
+     lambda m, x: m.histogram_bin_edges(m.array(x.ravel()), bins=5),
+     lambda x: onp.histogram_bin_edges(x.ravel(), bins=5)),
+    ("histogramdd",
+     lambda m, x: m.histogramdd(m.array(x[:, :2]), bins=3)[0],
+     lambda x: onp.histogramdd(x[:, :2], bins=3)[0]),
+    ("intersect1d",
+     lambda m, x: m.intersect1d(m.array(_xi().ravel()),
+                                m.array(_xi().ravel()[:5])),
+     lambda x: onp.intersect1d(_xi().ravel(), _xi().ravel()[:5])),
+    ("isin",
+     lambda m, x: m.isin(m.array(_xi()),
+                         m.array(onp.array([1, 2], onp.int32))),
+     lambda x: onp.isin(_xi(), onp.array([1, 2]))),
+    ("iscomplexobj", lambda m, x: m.iscomplexobj(m.array(x)),
+     lambda x: onp.iscomplexobj(x)),
+    ("isrealobj", lambda m, x: m.isrealobj(m.array(x)),
+     lambda x: onp.isrealobj(x)),
+    ("isscalar", lambda m, x: m.isscalar(3.0),
+     lambda x: onp.isscalar(3.0)),
+    ("ix_",
+     lambda m, x: m.ix_(m.array(onp.array([0, 2])),
+                        m.array(onp.array([1, 3])))[0],
+     lambda x: onp.ix_(onp.array([0, 2]), onp.array([1, 3]))[0]),
+    ("lexsort", lambda m, x: m.lexsort((m.array(x[0]), m.array(x[1]))),
+     lambda x: onp.lexsort((x[0], x[1]))),
+    ("mask_indices", lambda m, x: m.mask_indices(3, m.triu)[0],
+     lambda x: onp.mask_indices(3, onp.triu)[0]),
+    ("matrix_transpose", lambda m, x: m.matrix_transpose(m.array(x)),
+     lambda x: onp.swapaxes(x, -1, -2)),
+    ("modf", lambda m, x: m.modf(m.array(x))[0],
+     lambda x: onp.modf(x)[0]),
+    ("nanpercentile", lambda m, x: m.nanpercentile(m.array(x), 40.0),
+     lambda x: onp.nanpercentile(x, 40.0)),
+    ("nanquantile", lambda m, x: m.nanquantile(m.array(x), 0.4),
+     lambda x: onp.nanquantile(x, 0.4)),
+    ("packbits",
+     lambda m, x: m.packbits(m.array((_xi() % 2).astype(onp.uint8))),
+     lambda x: onp.packbits((_xi() % 2).astype(onp.uint8))),
+    ("unpackbits",
+     lambda m, x: m.unpackbits(m.array(onp.array([7, 200], onp.uint8))),
+     lambda x: onp.unpackbits(onp.array([7, 200], onp.uint8))),
+    ("partition", lambda m, x: m.partition(m.array(x[0]), 2)[2],
+     lambda x: onp.partition(x[0], 2)[2]),
+    ("permute_dims", lambda m, x: m.permute_dims(m.array(x), (1, 0)),
+     lambda x: onp.transpose(x, (1, 0))),
+    ("polyadd",
+     lambda m, x: m.polyadd(m.array(x[0, :3]), m.array(x[1, :3])),
+     lambda x: onp.polyadd(x[0, :3], x[1, :3])),
+    ("polyder", lambda m, x: m.polyder(m.array(x[0, :4])),
+     lambda x: onp.polyder(x[0, :4])),
+    ("polyint", lambda m, x: m.polyint(m.array(x[0, :4])),
+     lambda x: onp.polyint(x[0, :4])),
+    ("polymul",
+     lambda m, x: m.polymul(m.array(x[0, :3]), m.array(x[1, :3])),
+     lambda x: onp.polymul(x[0, :3], x[1, :3])),
+    ("polysub",
+     lambda m, x: m.polysub(m.array(x[0, :3]), m.array(x[1, :3])),
+     lambda x: onp.polysub(x[0, :3], x[1, :3])),
+    ("polyval",
+     lambda m, x: m.polyval(m.array(x[0, :3]), m.array(x[1])),
+     lambda x: onp.polyval(x[0, :3], x[1])),
+    ("resize", lambda m, x: m.resize(m.array(x), (2, 3)),
+     lambda x: onp.resize(x, (2, 3))),
+    ("setdiff1d",
+     lambda m, x: m.setdiff1d(m.array(_xi().ravel()),
+                              m.array(onp.array([0, 1], onp.int32))),
+     lambda x: onp.setdiff1d(_xi().ravel(), onp.array([0, 1]))),
+    ("setxor1d",
+     lambda m, x: m.setxor1d(m.array(onp.array([1, 2, 3])),
+                             m.array(onp.array([2, 3, 4]))),
+     lambda x: onp.setxor1d(onp.array([1, 2, 3]),
+                            onp.array([2, 3, 4]))),
+    ("sort_complex",
+     lambda m, x: m.sort_complex(m.array(onp.array([3.0, 1.0, 2.0]))),
+     lambda x: onp.sort_complex(onp.array([3.0, 1.0, 2.0]))),
+    ("spacing", lambda m, x: m.spacing(m.array(x)),
+     lambda x: onp.spacing(x)),
+    ("tril_indices_from",
+     lambda m, x: m.tril_indices_from(m.array(x[:4, :4]))[0],
+     lambda x: onp.tril_indices_from(x[:4, :4])[0]),
+    ("triu_indices_from",
+     lambda m, x: m.triu_indices_from(m.array(x[:4, :4]))[1],
+     lambda x: onp.triu_indices_from(x[:4, :4])[1]),
+    ("union1d",
+     lambda m, x: m.union1d(m.array(onp.array([1, 2, 3])),
+                            m.array(onp.array([2, 5]))),
+     lambda x: onp.union1d(onp.array([1, 2, 3]), onp.array([2, 5]))),
+    ("unwrap", lambda m, x: m.unwrap(m.array(x[0] * 3)),
+     lambda x: onp.unwrap(x[0] * 3)),
+    ("vander", lambda m, x: m.vander(m.array(x[0, :3]), 3),
+     lambda x: onp.vander(x[0, :3], 3)),
+    ("vecdot", lambda m, x: m.vecdot(m.array(x), m.array(x)),
+     lambda x: (x * x).sum(-1)),
+]
+
+
+@pytest.mark.parametrize("case", EXT_FNS6, ids=[c[0] for c in EXT_FNS6])
+def test_np_extended_surface_round6(case):
+    name, mx_fn, onp_fn = case
+    if not hasattr(np, name):
+        pytest.skip(f"mx.np.{name} absent")
+    x = _r((4, 5), 61)
+    got = mx_fn(np, x)
+    got = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    want = onp.asarray(onp_fn(x))
+    assert got.shape == want.shape, \
+        f"{name}: shape {got.shape} vs numpy {want.shape}"
+    if want.dtype.kind == "b":
+        assert onp.dtype(got.dtype).kind == "b", \
+            f"{name}: bool result came back as {got.dtype}"
+        onp.testing.assert_array_equal(got, want)
+    elif want.dtype.kind in "iu":
+        assert onp.dtype(got.dtype).kind in "iu", \
+            f"{name}: integer result came back as {got.dtype}"
+        onp.testing.assert_array_equal(got, want)
+    else:
+        onp.testing.assert_allclose(onp.asarray(got, want.dtype), want,
+                                    rtol=2e-5, atol=2e-6)
+
+
 @pytest.mark.parametrize("case", EXT_FNS5, ids=[c[0] for c in EXT_FNS5])
 def test_np_extended_surface_round5(case):
     name, mx_fn, onp_fn = case

@@ -81,6 +81,53 @@ def test_router_dispatch_results_and_balance(tmp_path):
             telemetry.disable()
 
 
+def test_router_prefix_affinity_dispatch(tmp_path):
+    """ISSUE 15 satellite: least-loaded TIES prefer the replica that
+    last served the same prompt-prefix hash (so the tier hits the
+    per-replica paged-KV prefix cache), distinct prefixes still rotate,
+    and a drained affinity target falls back cleanly to a survivor."""
+    telemetry.enable()
+    r = _router(tmp_path, affinity_tokens=4)
+    try:
+        assert r.wait_up() == 2
+
+        def served_by(handle):
+            for e in telemetry.get_tracer().events():
+                if e.get("cat") == "router.request" \
+                        and e.get("name") == "dispatched" \
+                        and e.get("id") == handle.rid:
+                    return e["args"]["replica"]
+            raise AssertionError(f"no dispatch event for {handle.rid}")
+
+        base = [3, 1, 4, 1]
+        homes = []
+        for i in range(6):          # sequential: replicas tie on load
+            p = base + [10 + i]
+            h = r.submit(p, max_new_tokens=3)
+            assert h.result(timeout=30) == oracle_tokens(p, 3)
+            homes.append(served_by(h))
+        # every shared-prefix request stuck to ONE replica
+        assert len(set(homes)) == 1, homes
+        # distinct prefixes keep rotating over the tier
+        spread = []
+        for i in range(4):
+            p = [50 + i, 60 + i, 70 + i, 80 + i, 1]
+            h = r.submit(p, max_new_tokens=3)
+            assert h.result(timeout=30) == oracle_tokens(p, 3)
+            spread.append(served_by(h))
+        assert set(spread) == {0, 1}, spread
+        # fallback: the affinity target goes away -> survivor serves
+        assert r.drain(homes[0], restart=False)
+        p = base + [99]
+        h = r.submit(p, max_new_tokens=3)
+        assert h.result(timeout=30) == oracle_tokens(p, 3)
+        assert served_by(h) == 1 - homes[0]
+    finally:
+        r.stop()
+        if not telemetry.env_enabled():
+            telemetry.disable()
+
+
 def test_replica_death_mid_decode_retry_token_identical(tmp_path):
     """A replica dying BEFORE it computes (the mid-decode death shape)
     has its request transparently resubmitted to the survivor, which

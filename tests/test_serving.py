@@ -115,6 +115,43 @@ def test_paged_cache_admit_release_reuse():
     assert reused[0] in freed
 
 
+def test_paged_cache_prefix_share_refcount_evict():
+    """Prefix-cache bookkeeping without jax: registration, full-block
+    sharing with refcounts, COW pair production, LRU eviction of
+    refcount-0 cached blocks under pressure."""
+    c = serving.PagedKVCache(max_batch=3, max_blocks_per_seq=4,
+                             block_tokens=4, num_blocks=6,
+                             prefix_cache=True)
+    prompt = list(range(10, 20))          # 10 tokens = 2 full blocks + 2
+    c.admit(0, 10, prompt)
+    c.register_prefix(0, prompt)
+    assert c.prefix_hits == 0             # cold admission
+    # a second identical-prefix admission shares the 2 full blocks
+    h0 = c.prefix_hit_tokens
+    c.admit(1, 10, prompt)
+    assert c.prefix_hits == 1 and c.prefix_hit_tokens - h0 == 8
+    assert c.tables[1][0] == c.tables[0][0]
+    assert c.tables[1][1] == c.tables[0][1]
+    # COW: slot 1 about to write inside the SHARED second block
+    pairs = c.prepare_write(1, 5)
+    assert len(pairs) == 1 and pairs[0][0] == c.tables[0][1]
+    assert c.tables[1][1] == pairs[0][1] != c.tables[0][1]
+    assert c.cow_copies == 1
+    # sole-owner writes need no copy
+    assert c.prepare_write(0, 5) == []
+    # release both: registered blocks park on the cached LRU, not free
+    c.release(0)
+    c.release(1)
+    assert c.cached_blocks == 2
+    # pressure: a big admission evicts cached blocks LRU-first
+    c.admit(2, 16)                        # 4 blocks > 3 free
+    assert c.evictions == 1 and c.cached_blocks == 1
+    c.release(2)
+    # the evicted deeper key is gone; the surviving first block still hits
+    _blocks, toks = c.match_prefix(prompt)
+    assert toks == 4
+
+
 # -- llama: token identity ---------------------------------------------------
 
 def test_llama_paged_decode_token_identical(llama_net):
@@ -346,6 +383,206 @@ def test_deadline_lapsing_during_admission_skips_prefill(llama_net):
         assert ha.result(timeout=5)
     finally:
         eng.adapter.prefill = orig_prefill
+
+
+# -- prefix caching (ISSUE 15 tentpole) --------------------------------------
+
+SYS12 = [30 + i for i in range(12)]       # 3 full blocks at T=4
+
+
+def test_prefix_cache_hit_token_identical(llama_net):
+    """Shared-system-prompt workload: prefix-cache-hit generations are
+    bitwise-equal to cold-start, tail-only prefill computes fewer
+    positions, and the hit/hit-token telemetry moves."""
+    prompts = [SYS12 + [60 + i] for i in range(5)]
+    cold = [_ref_greedy_llama(llama_net, p, 8) for p in prompts]
+    h0 = telemetry.counter("mxnet_serving_prefix_hits_total").value
+    p0 = telemetry.counter("mxnet_serving_prefill_positions_total").value
+    eng = _llama_engine(llama_net, prefix_cache=True)
+    outs = eng.generate(prompts, max_new_tokens=8)
+    assert outs == cold
+    assert eng.cache.prefix_hits == 4           # req 0 is the cold fill
+    assert eng.cache.prefix_hit_tokens == 4 * len(SYS12)
+    assert telemetry.counter(
+        "mxnet_serving_prefix_hits_total").value - h0 == 4
+    ppos = telemetry.counter(
+        "mxnet_serving_prefill_positions_total").value - p0
+    # 1 cold padded prefill + 4 one-block tail chunks << 5 cold prefills
+    assert ppos == eng.adapter.prefill_tokens + 4 * eng.block_tokens
+    assert ppos < 5 * eng.adapter.prefill_tokens
+
+
+def test_prefix_cow_on_scratch_adjacent_block(llama_net):
+    """Two CONCURRENT sequences with the same block-aligned prompt: the
+    sharer's boundary chunk must write the last shared block (the one
+    adjacent to the scratch-padded table tail) -> copy-on-write fires
+    and both outputs stay bitwise-equal to the cold path.  A non-aligned
+    duplicate (partial tail block) needs no COW: its tail starts at a
+    block boundary in a private block."""
+    p8 = [3, 1, 4, 1, 5, 9, 2, 6]               # 2 full blocks exactly
+    cold = _ref_greedy_llama(llama_net, p8, 8)
+    c0 = telemetry.counter("mxnet_serving_prefix_cow_total").value
+    eng = _llama_engine(llama_net, prefix_cache=True)
+    outs = eng.generate([p8, list(p8)], max_new_tokens=8)
+    assert outs == [cold, cold]
+    assert eng.cache.cow_copies >= 1
+    assert telemetry.counter(
+        "mxnet_serving_prefix_cow_total").value - c0 >= 1
+    p9 = p8 + [7]                               # partial third block
+    cold9 = _ref_greedy_llama(llama_net, p9, 8)
+    eng2 = _llama_engine(llama_net, prefix_cache=True)
+    outs2 = eng2.generate([p9, list(p9)], max_new_tokens=8)
+    assert outs2 == [cold9, cold9]
+    assert eng2.cache.cow_copies == 0 and eng2.cache.prefix_hits == 1
+
+
+def test_prefix_preemption_of_shared_blocks(llama_net):
+    """Cache-pressure corner: preempting a sequence whose blocks are
+    SHARED (refcount > 1) frees only its private blocks; the preempted
+    request recomputes and every output still matches the cold oracle."""
+    before = telemetry.counter(
+        "mxnet_serving_requests_preempted_total").value
+    sysp = [40 + i for i in range(8)]           # 2 shared full blocks
+    prompts = [sysp + [70], sysp + [71]]
+    eng = serving.ServingEngine(llama_net, eos_id=255, max_batch=2,
+                                block_tokens=4, max_seq=16,
+                                prefill_tokens=16, num_blocks=6,
+                                prefix_cache=True)
+    outs = eng.generate(prompts, max_new_tokens=6)
+    for p, got in zip(prompts, outs):
+        assert got == _ref_greedy_llama(llama_net, p, 6, eos=-1), p
+    after = telemetry.counter(
+        "mxnet_serving_requests_preempted_total").value
+    assert after > before                        # pressure really preempted
+    assert eng.cache.prefix_hits >= 1            # sharing really happened
+
+
+def test_prefix_eviction_races_readmission(llama_net):
+    """Cache-pressure corner: an unrelated admission evicts the cached
+    prefix between a request's first run and its resubmission — the
+    resubmit takes the cold path and stays token-identical."""
+    eng = serving.ServingEngine(llama_net, eos_id=EOS, max_batch=1,
+                                block_tokens=4, max_seq=24,
+                                prefill_tokens=16, num_blocks=6,
+                                prefix_cache=True)
+    pa = [5, 6, 7, 8, 9, 10, 11, 12]            # 2 registered full blocks
+    ra = eng.generate([pa], max_new_tokens=4)[0]
+    assert eng.cache.cached_blocks == 2
+    pb = list(range(50, 66))                    # 16 tokens: 4 blocks
+    eng.generate([pb], max_new_tokens=4)
+    assert eng.cache.evictions >= 1             # the race: prefix evicted
+    hits0 = eng.cache.prefix_hits
+    rb = eng.generate([pa], max_new_tokens=4)[0]
+    assert rb == ra == _ref_greedy_llama(llama_net, pa, 4)
+    assert eng.cache.prefix_hits == hits0       # evicted: no hit, cold path
+
+
+# -- speculative decoding (ISSUE 15 tentpole) --------------------------------
+
+@pytest.fixture(scope="module")
+def draft_net():
+    """A DIVERGENT draft (same llama_tiny config — the module-level jits
+    are shared — different seed): low acceptance, so the target-token
+    fallback path is exercised on every few dispatches."""
+    mx.random.seed(23)
+    np.random.seed(23)
+    net = llama.llama_model("llama_tiny", vocab_size=101)
+    net.initialize(mx.initializer.Normal(0.05))
+    net(mx.nd.array(np.zeros((1, 4), np.int32)))
+    return net
+
+
+def test_spec_decode_token_identical_mixed_batch(llama_net, draft_net):
+    """Speculative greedy output is bitwise-equal to plain greedy across
+    a mixed-length batch and across batch sizes."""
+    prompts = [[5, 9, 11], [7, 8, 9, 10, 3, 4], [40, 41], [12] * 9]
+    eng = _llama_engine(llama_net, draft_model=draft_net, spec_k=3)
+    outs = eng.generate(prompts, max_new_tokens=12)
+    for p, got in zip(prompts, outs):
+        assert got == _ref_greedy_llama(llama_net, p, 12), p
+    solo = _llama_engine(llama_net, max_batch=2, draft_model=draft_net,
+                         spec_k=2).generate([prompts[0]],
+                                            max_new_tokens=10)[0]
+    assert solo == _ref_greedy_llama(llama_net, prompts[0], 10)
+
+
+def test_spec_decode_early_eos(llama_net, draft_net):
+    """EOS inside an accepted run truncates the emission mid-chunk;
+    every sequence still matches its oracle exactly."""
+    prompts = [[5, 9, 11], [7, 8, 9, 10, 3, 4], [40, 41], [12] * 9,
+               [33, 2, 7], [90]]
+    free = [_ref_greedy_llama(llama_net, p, 10, eos=-1) for p in prompts]
+    eos = free[0][2]
+    refs = [_ref_greedy_llama(llama_net, p, 10, eos=eos) for p in prompts]
+    eng = serving.ServingEngine(llama_net, eos_id=eos, max_batch=3,
+                                block_tokens=4, max_seq=64,
+                                prefill_tokens=16,
+                                draft_model=draft_net, spec_k=3)
+    outs = eng.generate(prompts, max_new_tokens=10)
+    assert outs == refs
+    assert any(o[-1] == eos and len(o) < 10 for o in outs)
+
+
+def test_spec_decode_preemption_token_identical(llama_net, draft_net):
+    """Pool pressure with speculation armed: preemption-by-recompute
+    still converges bit-identically (the spec chunk reserves multiple
+    positions per slot, so pressure bites earlier)."""
+    before = telemetry.counter(
+        "mxnet_serving_requests_preempted_total").value
+    eng = serving.ServingEngine(llama_net, eos_id=255, max_batch=2,
+                                block_tokens=4, max_seq=16,
+                                prefill_tokens=16, num_blocks=5,
+                                draft_model=draft_net, spec_k=2)
+    prompts = [[5, 9, 11, 13], [7, 8, 9, 10]]
+    outs = eng.generate(prompts, max_new_tokens=10)
+    for p, got in zip(prompts, outs):
+        assert got == _ref_greedy_llama(llama_net, p, 10, eos=-1), p
+    assert telemetry.counter(
+        "mxnet_serving_requests_preempted_total").value > before
+
+
+def test_spec_identical_draft_tokens_per_dispatch(llama_net):
+    """An identical-weights draft accepts ~everything: generated tokens
+    per target dispatch >= 1.5 (the serve-bench gate's mechanism) and
+    the accepted-draft-length histogram populates."""
+    telemetry.enable()
+    try:
+        t0 = telemetry.counter("mxnet_serving_tokens_total").value
+        s0 = telemetry.counter("mxnet_serving_decode_steps_total").value
+        hist = telemetry.REGISTRY.get("mxnet_serving_accepted_draft_tokens")
+        hc0 = hist.count if hist is not None else 0
+        eng = _llama_engine(llama_net, draft_model=llama_net, spec_k=3)
+        outs = eng.generate([[5, 6, 7], [8, 9]], max_new_tokens=12)
+        for p, got in zip([[5, 6, 7], [8, 9]], outs):
+            assert got == _ref_greedy_llama(llama_net, p, 12), p
+        toks = telemetry.counter("mxnet_serving_tokens_total").value - t0
+        steps = telemetry.counter(
+            "mxnet_serving_decode_steps_total").value - s0
+        assert steps > 0 and toks / steps >= 1.5, (toks, steps)
+        hist = telemetry.REGISTRY.get("mxnet_serving_accepted_draft_tokens")
+        assert hist.count > hc0
+    finally:
+        if not telemetry.env_enabled():
+            telemetry.disable()
+
+
+def test_prefix_and_spec_no_retrace(llama_net, draft_net):
+    """Acceptance: steady-state serving with BOTH features armed
+    compiles nothing — cold prefills, tail chunks, draft steps and
+    verify dispatches all hold their fixed shapes."""
+    eng = _llama_engine(llama_net, prefix_cache=True,
+                        draft_model=draft_net, spec_k=3)
+    # warm every executable: cold prefill, a prefix-hit tail chunk,
+    # draft steps, and the (B, K) verify
+    eng.generate([SYS12 + [77], SYS12 + [78], [1, 2, 3]],
+                 max_new_tokens=6)
+    with no_retrace():
+        outs = eng.generate(
+            [SYS12 + [88], SYS12 + [89], [4, 5], [9] * 7],
+            max_new_tokens=9)
+    cold = [_ref_greedy_llama(llama_net, p, 9)
+            for p in [SYS12 + [88], SYS12 + [89], [4, 5], [9] * 7]]
+    assert outs == cold
 
 
 # -- transformer (encoder-decoder) ------------------------------------------
