@@ -100,6 +100,31 @@ KNOWN_VARS = {
         "/statusz (knobs, world, stepclock verdict, serving gauges), "
         "/ledger.json (cost + op ledgers).  0 binds an ephemeral port; "
         "unset (default) = no server."),
+    # perf-regression observatory (ISSUE 16: telemetry.perfgate +
+    # tools/perfgate.py + tools/onchip_sweep.py)
+    "MXNET_PERFGATE_BASELINE": (
+        None, str,
+        "Path of the committed analytic perf baseline the gate diffs "
+        "against (tools/perfgate.py --check, /perfgate.json, "
+        "telemetry_report --perf-diff).  Unset (default) = the repo's "
+        "tests/perf_baseline.json."),
+    "MXNET_PERFGATE_LANES": (
+        None, str,
+        "Comma-separated lane filter for perfgate snapshot/check runs "
+        "(e.g. 'bert_headline,trainer_fused_kvstore').  Unset (default) "
+        "= every registered lane; a filtered --check is reported as "
+        "PARTIAL."),
+    "MXNET_PERFGATE_CHILD_TIMEOUT_S": (
+        "420", float,
+        "Per-lane wall budget for the perfgate snapshot child processes "
+        "(each lane compiles + runs its steady-state window on the CPU "
+        "backend in a fresh interpreter)."),
+    "MXNET_PERFGATE_MFU_BAND": (
+        "0.25", float,
+        "Relative band for the on-chip sweep's measured-vs-analytic MFU "
+        "assertion (tools/onchip_sweep.py, PROFILE.md r10 protocol: "
+        "analytic MFU counts ALL XLA-emitted flops, so it sits a few "
+        "percent above the hand-derived number)."),
     "MXNET_STEPCLOCK_WINDOW": (
         "64", int,
         "Steps the StepClock keeps for the rolling input-/comms-/compute-"

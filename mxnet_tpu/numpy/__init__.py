@@ -181,6 +181,20 @@ _NP_FUNCS = [
     "polyval", "resize", "setdiff1d", "setxor1d", "sort_complex",
     "spacing", "tril_indices_from", "triu_indices_from", "union1d",
     "unwrap", "vander", "vecdot",
+    # delegated-surface round 7 (ISSUE 16 satellite): the array-API
+    # trig/bitwise aliases (acos/atan2/pow/bitwise_left_shift/…),
+    # cumulative_sum/prod + unstack/astype, the polynomial solvers
+    # (poly/polyfit/polydiv/roots), popcount, block assembly, and the
+    # unique_* array-API quartet.  put/place/fill_diagonal are bound as
+    # host-side shims below — jnp requires ``inplace=False`` there and
+    # returns the updated copy (jax arrays are immutable; numpy mutates);
+    # block gets a deep-unwrap shim (nested argument lists).
+    "acos", "acosh", "asin", "asinh", "atan", "atan2", "atanh", "pow",
+    "bitwise_count", "bitwise_invert", "bitwise_left_shift",
+    "bitwise_right_shift", "block", "cumulative_prod", "cumulative_sum",
+    "astype", "fmod", "isdtype", "poly", "polydiv", "polyfit", "roots",
+    "unique_all", "unique_counts", "unique_inverse", "unique_values",
+    "unstack",
 ]
 
 _self = _sys.modules[__name__]
@@ -244,6 +258,52 @@ def _populate():
 
     mask_indices.__doc__ = jnp.mask_indices.__doc__
     _self.mask_indices = mask_indices
+    # numpy's put/place/fill_diagonal mutate their first argument and
+    # return None; jax arrays are immutable, so jnp exposes them only
+    # with ``inplace=False`` (anything else raises) and returns the
+    # updated copy.  Bind host-side shims that unwrap NDArrays, pass
+    # inplace=False, and return the copy — the documented divergence
+    # (ISSUE 16 round-7 catch, same family as the mask_indices shim).
+
+    def _unwrap(v):
+        return v._data if isinstance(v, NDArray) else v
+
+    def _rewrap(out):
+        return NDArray._from_data(out, ctx=current_context())
+
+    def put(a, ind, v, mode="clip"):
+        return _rewrap(jnp.put(_unwrap(a), _unwrap(ind), _unwrap(v),
+                               mode=mode, inplace=False))
+
+    put.__doc__ = jnp.put.__doc__
+    _self.put = put
+
+    def place(arr, mask, vals):
+        return _rewrap(jnp.place(_unwrap(arr), _unwrap(mask),
+                                 _unwrap(vals), inplace=False))
+
+    place.__doc__ = jnp.place.__doc__
+    _self.place = place
+
+    def fill_diagonal(a, val, wrap=False):
+        return _rewrap(jnp.fill_diagonal(_unwrap(a), _unwrap(val),
+                                         wrap=wrap, inplace=False))
+
+    fill_diagonal.__doc__ = jnp.fill_diagonal.__doc__
+    _self.fill_diagonal = fill_diagonal
+    # jnp.block takes NESTED lists of arrays; the registry delegation
+    # only unwraps flat argument lists, so NDArrays one level down reach
+    # jnp verbatim and it chokes (same round-7 catch) — deep-unwrap here
+
+    def block(arrays):
+        def _deep(v):
+            if isinstance(v, (list, tuple)):
+                return [_deep(u) for u in v]
+            return _unwrap(v)
+        return _rewrap(jnp.block(_deep(arrays)))
+
+    block.__doc__ = jnp.block.__doc__
+    _self.block = block
     # subnamespaces
     lin = _types.ModuleType(__name__ + ".linalg")
     import jax.numpy.linalg as jla

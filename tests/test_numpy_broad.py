@@ -1122,3 +1122,137 @@ def test_np_extended_surface_round5(case):
     else:
         onp.testing.assert_allclose(onp.asarray(got, want.dtype), want,
                                     rtol=2e-5, atol=2e-6)
+
+
+# -- round 7 (ISSUE 16): array-API aliases, polynomial solvers, unique_*
+# quartet, popcount/shift family, block assembly, and the put/place/
+# fill_diagonal copy-returning shims (documented divergence: jax arrays
+# are immutable, numpy mutates in place).
+
+def _put_ref(x):
+    y = x.copy()
+    onp.put(y, [0, 2], [9.0, 8.0])
+    return y
+
+
+def _place_ref(x):
+    y = x.copy()
+    onp.place(y, x > 0, [5.0])
+    return y
+
+
+def _fill_diag_ref(x):
+    y = x[:4, :4].copy()
+    onp.fill_diagonal(y, 7.0)
+    return y
+
+
+def _popcount_ref(x):
+    xi = _xi()
+    return onp.array([[bin(int(v)).count("1") for v in row] for row in xi],
+                     onp.int32)
+
+
+EXT_FNS7 = [
+    ("acos", lambda m, x: m.acos(m.array(onp.tanh(x))),
+     lambda x: onp.arccos(onp.tanh(x))),
+    ("acosh", lambda m, x: m.acosh(m.array(1.0 + x * x)),
+     lambda x: onp.arccosh(1.0 + x * x)),
+    ("asin", lambda m, x: m.asin(m.array(onp.tanh(x))),
+     lambda x: onp.arcsin(onp.tanh(x))),
+    ("asinh", lambda m, x: m.asinh(m.array(x)),
+     lambda x: onp.arcsinh(x)),
+    ("atan", lambda m, x: m.atan(m.array(x)), lambda x: onp.arctan(x)),
+    ("atan2", lambda m, x: m.atan2(m.array(x), m.array(x + 1.5)),
+     lambda x: onp.arctan2(x, x + 1.5)),
+    ("atanh", lambda m, x: m.atanh(m.array(onp.tanh(x) * 0.9)),
+     lambda x: onp.arctanh(onp.tanh(x) * 0.9)),
+    ("pow", lambda m, x: m.pow(m.array(onp.abs(x) + 0.5), 2),
+     lambda x: onp.power(onp.abs(x) + 0.5, 2)),
+    ("bitwise_count", lambda m, x: m.bitwise_count(m.array(_xi())),
+     _popcount_ref),
+    ("bitwise_invert", lambda m, x: m.bitwise_invert(m.array(_xi())),
+     lambda x: onp.invert(_xi())),
+    ("bitwise_left_shift",
+     lambda m, x: m.bitwise_left_shift(m.array(_xi()), 2),
+     lambda x: onp.left_shift(_xi(), 2)),
+    ("bitwise_right_shift",
+     lambda m, x: m.bitwise_right_shift(m.array(_xi()), 1),
+     lambda x: onp.right_shift(_xi(), 1)),
+    ("block", lambda m, x: m.block([[m.array(x)], [m.array(x)]]),
+     lambda x: onp.block([[x], [x]])),
+    ("cumulative_sum",
+     lambda m, x: m.cumulative_sum(m.array(x), axis=1),
+     lambda x: onp.cumsum(x, axis=1)),
+    ("cumulative_prod",
+     lambda m, x: m.cumulative_prod(m.array(x), axis=1),
+     lambda x: onp.cumprod(x, axis=1)),
+    ("astype", lambda m, x: m.astype(m.array(x * 10), "int32"),
+     lambda x: (x * 10).astype(onp.int32)),
+    ("fmod", lambda m, x: m.fmod(m.array(_xi()), 3),
+     lambda x: onp.fmod(_xi(), 3)),
+    ("isdtype",
+     lambda m, x: onp.array(m.isdtype(onp.dtype("float32"),
+                                      "real floating")),
+     lambda x: onp.array(True)),
+    ("poly", lambda m, x: m.poly(m.array(x[0, :3])),
+     lambda x: onp.poly(x[0, :3])),
+    ("polydiv",
+     lambda m, x: m.polydiv(m.array(onp.array([1.0, 3.0, 2.0])),
+                            m.array(onp.array([1.0, 1.0])))[0],
+     lambda x: onp.polydiv(onp.array([1.0, 3.0, 2.0]),
+                           onp.array([1.0, 1.0]))[0]),
+    ("polyfit",
+     lambda m, x: m.polyfit(m.array(onp.arange(5.0)), m.array(x[1]), 1),
+     lambda x: onp.polyfit(onp.arange(5.0), x[1], 1)),
+    ("roots",
+     lambda m, x: m.sort(m.abs(m.roots(
+         m.array(onp.array([1.0, -3.0, 2.0]))))),
+     lambda x: onp.sort(onp.abs(onp.roots(onp.array([1.0, -3.0, 2.0]))))),
+    ("unique_all", lambda m, x: m.unique_all(m.array(_xi()))[0],
+     lambda x: onp.unique(_xi())),
+    ("unique_counts", lambda m, x: m.unique_counts(m.array(_xi()))[1],
+     lambda x: onp.unique(_xi(), return_counts=True)[1]),
+    ("unique_inverse", lambda m, x: m.unique_inverse(m.array(_xi()))[1],
+     lambda x: onp.unique(_xi(), return_inverse=True)[1].reshape(
+         _xi().shape)),
+    ("unique_values", lambda m, x: m.unique_values(m.array(_xi())),
+     lambda x: onp.unique(_xi())),
+    ("unstack", lambda m, x: m.unstack(m.array(x))[1],
+     lambda x: x[1]),
+    ("put",
+     lambda m, x: m.put(m.array(x), m.array(onp.array([0, 2])),
+                        m.array(onp.array([9.0, 8.0], onp.float32))),
+     _put_ref),
+    ("place",
+     lambda m, x: m.place(m.array(x), m.array(x > 0),
+                          m.array(onp.array([5.0], onp.float32))),
+     _place_ref),
+    ("fill_diagonal",
+     lambda m, x: m.fill_diagonal(m.array(x[:4, :4]), 7.0),
+     _fill_diag_ref),
+]
+
+
+@pytest.mark.parametrize("case", EXT_FNS7, ids=[c[0] for c in EXT_FNS7])
+def test_np_extended_surface_round7(case):
+    name, mx_fn, onp_fn = case
+    if not hasattr(np, name):
+        pytest.skip(f"mx.np.{name} absent")
+    x = _r((4, 5), 71)
+    got = mx_fn(np, x)
+    got = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    want = onp.asarray(onp_fn(x))
+    assert got.shape == want.shape, \
+        f"{name}: shape {got.shape} vs numpy {want.shape}"
+    if want.dtype.kind == "b":
+        assert onp.dtype(got.dtype).kind == "b", \
+            f"{name}: bool result came back as {got.dtype}"
+        onp.testing.assert_array_equal(got, want)
+    elif want.dtype.kind in "iu":
+        assert onp.dtype(got.dtype).kind in "iu", \
+            f"{name}: integer result came back as {got.dtype}"
+        onp.testing.assert_array_equal(got, want)
+    else:
+        onp.testing.assert_allclose(onp.asarray(got, want.dtype), want,
+                                    rtol=2e-5, atol=2e-6)

@@ -1,0 +1,390 @@
+"""Hardware-free perf-regression gate (ISSUE 16): tolerance bands,
+baseline digest/validation, added/removed lanes, the live-delta plane,
+the injected-regression red path, and the sweep/CLI wiring.
+
+The diff engine is pure dict-math, so most of this file runs in
+microseconds; the red test runs the kvstore lane in-process twice (the
+knob is read at kvstore construction), and the subprocess tests drive
+the actual CLIs the CI lanes call.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry import costmodel, httpd, tracer
+from mxnet_tpu.telemetry import perfgate as pg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tests", "perf_baseline.json")
+
+
+def _rec(**over):
+    """A synthetic lane record shaped exactly like _finish_record's."""
+    rec = {
+        "config": {"batch": 4, "seq_len": 32},
+        "metrics": {
+            "dispatches_per_step": 2.0, "executables": 3,
+            "retraces_steady": 0, "flops": 1000000,
+            "bytes_accessed": 400000, "peak_hbm_bytes": 800000,
+            "analytic_mfu": 0.25, "analytic_step_s": 2e-06,
+            "verdict": "compute-bound",
+        },
+        "sites": {"train.step": {
+            "executables": 1, "calls": 4, "flops": 1000000.0,
+            "bytes_accessed": 400000.0, "peak_bytes": 800000}},
+        "counters": {"mxnet_op_dispatch_total": 8},
+        "observed": {"steady_wall_s": 0.5, "wall_s_per_step": 0.25,
+                     "measured_mfu": 0.01},
+    }
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(rec.get(k), dict):
+            rec[k] = {**rec[k], **v}
+        else:
+            rec[k] = v
+    return rec
+
+
+# -- tolerance bands ---------------------------------------------------------
+
+def test_identical_lanes_pass():
+    report = pg.diff_snapshots({"a": _rec()}, {"a": _rec()})
+    assert report["ok"]
+    assert report["lanes"]["a"]["verdict"] == "ok"
+
+
+@pytest.mark.parametrize("metric,base,inside,outside", [
+    ("flops", 1000000, 1019000, 1021000),              # ±2% class
+    ("bytes_accessed", 400000, 407600, 408800),
+    ("analytic_mfu", 0.25, 0.2549, 0.2552),
+    ("peak_hbm_bytes", 800000, 839000, 841000),        # ±5% class
+])
+def test_relative_band_boundaries(metric, base, inside, outside):
+    b = _rec(metrics={metric: base})
+    ok = pg.diff_lane(b, _rec(metrics={metric: inside}))
+    assert not [f for f in ok if f["metric"] == metric], ok
+    bad = pg.diff_lane(b, _rec(metrics={metric: outside}))
+    assert [f for f in bad if f["metric"] == metric]
+
+
+@pytest.mark.parametrize("metric,base,drifted", [
+    ("dispatches_per_step", 2.0, 2.5),    # structural: ANY change fails
+    ("executables", 3, 4),
+    ("retraces_steady", 0, 1),
+    ("verdict", "compute-bound", "memory-bound"),
+])
+def test_exact_metrics_fail_on_any_drift(metric, base, drifted):
+    fails = pg.diff_lane(_rec(metrics={metric: base}),
+                         _rec(metrics={metric: drifted}))
+    assert [f for f in fails if f["metric"] == metric]
+
+
+def test_counters_config_and_sites_are_exact():
+    base = _rec()
+    fails = pg.diff_lane(base, _rec(counters={"mxnet_op_dispatch_total": 9}))
+    assert any(f["metric"] == "counters.mxnet_op_dispatch_total"
+               for f in fails)
+    fails = pg.diff_lane(base, _rec(config={"batch": 8, "seq_len": 32}))
+    assert any(f["metric"] == "config" for f in fails)
+    # a site disappearing (e.g. a fused path silently skipped) is loud
+    siteless = _rec()
+    siteless["sites"] = {}
+    fails = pg.diff_lane(base, siteless)
+    assert any(f["metric"] == "sites.train.step" for f in fails)
+    # a metric KEY vanishing is a failure, not a silent skip
+    fresh = _rec()
+    del fresh["metrics"]["retraces_steady"]
+    fails = pg.diff_lane(base, fresh)
+    assert any(f["metric"] == "retraces_steady" and f["got"] is None
+               for f in fails)
+
+
+def test_added_and_removed_lanes_are_loud():
+    base = {"a": _rec(), "b": _rec()}
+    report = pg.diff_snapshots(base, {"a": _rec(), "c": _rec()})
+    assert not report["ok"]
+    assert report["added"] == ["c"]
+    assert report["removed"] == ["b"]
+    assert report["lanes"]["b"]["verdict"] == "removed"
+    assert report["lanes"]["c"]["verdict"] == "added"
+    lines = "\n".join(pg.report_lines(report))
+    assert "[ADDED]" in lines and "[GONE ]" in lines
+    assert "perfgate verdict: FAIL" in lines
+
+
+# -- canonical serialization + digest ----------------------------------------
+
+def test_canonical_strips_volatile_observed_block():
+    lanes = pg.canonical_lanes({"a": _rec()})
+    assert "observed" not in lanes["a"]
+    assert "metrics" in lanes["a"]
+    # wall-clock differences therefore never move the digest
+    other = _rec(observed={"steady_wall_s": 99.0, "wall_s_per_step": 9.0,
+                           "measured_mfu": 0.9})
+    assert pg.lanes_digest({"a": _rec()}) == pg.lanes_digest({"a": other})
+
+
+def test_dump_doc_is_byte_deterministic(tmp_path):
+    doc1 = pg.canonical_doc({"a": _rec()}, reasons=[{"reason": "r"}])
+    doc2 = pg.canonical_doc({"a": _rec()}, reasons=[{"reason": "r"}])
+    assert pg.dump_doc(doc1) == pg.dump_doc(doc2)
+    p = tmp_path / "b.json"
+    p.write_text(pg.dump_doc(doc1))
+    assert pg.load_baseline(str(p))["digest"] == doc1["digest"]
+
+
+def test_hand_edited_baseline_rejected(tmp_path):
+    doc = pg.canonical_doc({"a": _rec()}, reasons=[])
+    doc["lanes"]["a"]["metrics"]["flops"] += 1          # the hand edit
+    p = tmp_path / "edited.json"
+    p.write_text(pg.dump_doc(doc))
+    with pytest.raises(pg.BaselineError, match="digest mismatch"):
+        pg.load_baseline(str(p))
+
+
+def test_corrupt_and_invalid_baselines_rejected(tmp_path):
+    p = tmp_path / "x.json"
+    with pytest.raises(pg.BaselineError, match="no committed baseline"):
+        pg.load_baseline(str(p))
+    p.write_text("{not json")
+    with pytest.raises(pg.BaselineError, match="not valid JSON"):
+        pg.load_baseline(str(p))
+    with pytest.raises(pg.BaselineError, match="schema"):
+        pg.validate_baseline({"schema": 99, "lanes": {"a": _rec()}})
+    incomplete = _rec()
+    del incomplete["metrics"]["analytic_mfu"]
+    doc = pg.canonical_doc({"a": incomplete}, reasons=[])
+    with pytest.raises(pg.BaselineError, match="missing metrics"):
+        pg.validate_baseline(doc)
+
+
+def test_committed_baseline_is_valid_and_covers_lane_registry():
+    doc = pg.load_baseline(BASELINE)
+    assert set(doc["lanes"]) == set(pg.lane_names())
+    assert len(doc["lanes"]) >= 6
+    assert doc["reasons"], "the append-only reason log must not be empty"
+
+
+def test_default_baseline_path_env_override(monkeypatch):
+    monkeypatch.setenv("MXNET_PERFGATE_BASELINE", "/tmp/elsewhere.json")
+    assert pg.default_baseline_path() == "/tmp/elsewhere.json"
+    monkeypatch.delenv("MXNET_PERFGATE_BASELINE")
+    assert pg.default_baseline_path() == BASELINE
+
+
+# -- live delta (httpd /perfgate.json + telemetry_report --perf-diff) --------
+
+def _doc_one_lane():
+    return pg.canonical_doc({"a": _rec()}, reasons=[])
+
+
+def test_live_delta_overlap_within_band():
+    delta = pg.live_delta(_doc_one_lane(), {
+        "train.step": {"flops": 1010000.0, "bytes_accessed": 402000.0,
+                       "peak_bytes": 820000, "executables": 5, "calls": 99}})
+    assert delta["ok"] and delta["overlap_sites"] == 1
+    assert delta["lanes"]["a"]["verdict"] == "ok"
+
+
+def test_live_delta_drift_and_no_overlap():
+    delta = pg.live_delta(_doc_one_lane(),
+                          {"train.step": {"flops": 2000000.0,
+                                          "bytes_accessed": 400000.0,
+                                          "peak_bytes": 800000}},
+                          counters={"mxnet_op_dispatch_total": 3})
+    assert not delta["ok"]
+    assert any(f["metric"] == "sites.train.step.flops"
+               for f in delta["lanes"]["a"]["failures"])
+    assert delta["live_counters"] == {"mxnet_op_dispatch_total": 3}
+    empty = pg.live_delta(_doc_one_lane(), {"other.site": {"flops": 1.0}})
+    assert empty["ok"] and empty["overlap_sites"] == 0
+    assert empty["lanes"]["a"]["verdict"] == "no-overlap"
+
+
+def test_httpd_perfgate_endpoint(monkeypatch):
+    port = httpd.start(port=0)
+    try:
+        # no committed baseline at the override path -> 404 with JSON body
+        monkeypatch.setenv("MXNET_PERFGATE_BASELINE", "/nonexistent/b.json")
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/perfgate.json", timeout=10)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert json.load(e)["error"] == "no committed baseline"
+        # the committed repo baseline -> 200 live delta
+        monkeypatch.delenv("MXNET_PERFGATE_BASELINE")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/perfgate.json", timeout=10) as r:
+            body = json.load(r)
+        assert body["baseline_path"] == BASELINE
+        assert "lanes" in body and "ok" in body
+        # the /statusz row renders the same verdict machinery
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/statusz", timeout=10) as r:
+            status = json.load(r)
+        assert status["perfgate"] in ("ok", "no-overlap", "drift")
+    finally:
+        httpd.stop()
+
+
+def test_telemetry_report_perf_diff(tmp_path):
+    base = pg.canonical_doc({"a": _rec()}, reasons=[])
+    bp = tmp_path / "b.json"
+    bp.write_text(pg.dump_doc(base))
+    shard = {
+        "rank": 0, "pid": 1, "host": "t", "events": [], "metrics": [
+            {"kind": "counter", "name": "mxnet_op_dispatch_total",
+             "value": 4}],
+        "costmodel": {"entries": [
+            {"site": "train.step", "flops": 1000000.0,
+             "bytes_accessed": 400000.0, "peak_bytes": 800000}],
+            "calls": {"train.step": 4}},
+    }
+    (tmp_path / "telemetry-rank0-pid1.json").write_text(json.dumps(shard))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "telemetry_report.py"),
+         "--dir", str(tmp_path), "--perf-diff", str(bp)],
+        capture_output=True, text=True, env=env, timeout=120, cwd=REPO)
+    assert ok.returncode == 0, ok.stderr
+    assert "perf-diff verdict: ok" in ok.stdout
+    # drift the shard's flops far past the 2% band -> exit 2
+    shard["costmodel"]["entries"][0]["flops"] = 2000000.0
+    (tmp_path / "telemetry-rank0-pid1.json").write_text(json.dumps(shard))
+    bad = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "telemetry_report.py"),
+         "--dir", str(tmp_path), "--perf-diff", str(bp)],
+        capture_output=True, text=True, env=env, timeout=120, cwd=REPO)
+    assert bad.returncode == 2, bad.stdout + bad.stderr
+    assert "DRIFT" in bad.stderr
+
+
+# -- the injected-regression red path ----------------------------------------
+
+@pytest.fixture
+def clean_capture():
+    """Lane runners arm the tracer/ledger; restore the disarmed default."""
+    yield
+    costmodel.disarm()
+    costmodel.LEDGER.clear()
+    tracer.disable()
+    telemetry.clear()
+
+
+def test_injected_regression_turns_gate_red(monkeypatch, clean_capture):
+    """MXNET_KVSTORE_BUCKET_MB=0 degrades fused pushpull to the per-key
+    loop; the gate must catch the dispatch-per-step explosion.  The knob
+    is read at kvstore construction, so two in-process lane runs see the
+    clean and the degraded worlds."""
+    monkeypatch.delenv("MXNET_KVSTORE_BUCKET_MB", raising=False)
+    clean = pg.run_lane("trainer_fused_kvstore")
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_MB", "0")
+    degraded = pg.run_lane("trainer_fused_kvstore")
+    report = pg.diff_snapshots({"trainer_fused_kvstore": clean},
+                               {"trainer_fused_kvstore": degraded})
+    assert not report["ok"], "the gate stayed green under the regression"
+    fails = report["lanes"]["trainer_fused_kvstore"]["failures"]
+    assert any(f["metric"] == "dispatches_per_step" for f in fails), fails
+    # the explosion direction: strictly more dispatches than the fused path
+    assert (degraded["metrics"]["dispatches_per_step"]
+            > clean["metrics"]["dispatches_per_step"])
+
+
+# -- CLI wiring --------------------------------------------------------------
+
+def _run(cmd, timeout=120, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.run([sys.executable] + cmd, capture_output=True,
+                          text=True, env=env, timeout=timeout, cwd=REPO)
+
+
+def test_cli_check_rejects_corrupt_baseline(tmp_path):
+    p = tmp_path / "corrupt.json"
+    p.write_text("{not json")
+    r = _run([os.path.join(REPO, "tools", "perfgate.py"), "--check",
+              "--baseline", str(p)])
+    assert r.returncode == 2
+    assert "not valid JSON" in r.stderr
+
+
+def test_cli_write_baseline_requires_reason():
+    r = _run([os.path.join(REPO, "tools", "perfgate.py"),
+              "--write-baseline"])
+    assert r.returncode == 2
+    assert "--reason" in r.stderr
+
+
+def test_cli_list_names_every_lane():
+    r = _run([os.path.join(REPO, "tools", "perfgate.py"), "--list"])
+    assert r.returncode == 0, r.stderr
+    for name in pg.lane_names():
+        assert name in r.stdout
+
+
+def test_cli_write_baseline_byte_deterministic(tmp_path):
+    """Two independent child snapshots of the same lane serialize to the
+    exact same bytes — the acceptance bar for committing the baseline."""
+    cmd = [os.path.join(REPO, "tools", "perfgate.py"), "--write-baseline",
+           "--reason", "determinism test", "--lanes",
+           "trainer_fused_kvstore"]
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    for p in (a, b):
+        r = _run(cmd + ["--baseline", str(p)], timeout=300)
+        assert r.returncode == 0, r.stderr
+    assert a.read_bytes() == b.read_bytes()
+    doc = pg.load_baseline(str(a))
+    assert list(doc["lanes"]) == ["trainer_fused_kvstore"]
+
+
+# -- the on-chip sweep (ROADMAP 1) -------------------------------------------
+
+def test_sweep_dryrun_executes_every_lane(tmp_path):
+    """The CPU wiring proof: every r6–r12 addendum lane runs end to end,
+    emits one consolidated BENCH row, and the analytic-MFU pin against
+    the committed baseline holds."""
+    out = tmp_path / "sweep.json"
+    r = _run([os.path.join(REPO, "tools", "onchip_sweep.py"), "--dryrun",
+              "--json", str(out)], timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rows = [json.loads(l) for l in r.stdout.splitlines()
+            if l.startswith("{")]
+    by_metric = {row["metric"]: row for row in rows}
+    for lane in ("r06_opt_fusion", "r07_serve_knee", "r08_data_pipeline",
+                 "r09_mesh_mfu", "r10_analytic_mfu", "r11_fsdp_crossover",
+                 "r12_spec_prefix"):
+        assert by_metric[f"sweep_{lane}"]["ok"], by_metric[f"sweep_{lane}"]
+    summary = by_metric["onchip_sweep_summary"]
+    assert summary["lanes"] == 7 and summary["failed"] == []
+    # the analytic rows answer to the same committed baseline as the gate
+    for lane in ("sweep_r09_mesh_mfu", "sweep_r10_analytic_mfu"):
+        assert by_metric[lane]["mfu"]["analytic_within_gate_band"]
+    # r7+r12 ride ONE serve_bench child
+    assert by_metric["sweep_r12_spec_prefix"].get("shared_run") is True
+    # the planner lane re-proves the committed golden
+    assert by_metric["sweep_r11_fsdp_crossover"]["plan_matches_golden"]
+    report = json.loads(out.read_text())
+    assert len(report["lanes"]) == 7
+
+
+def test_sweep_budget_exhaustion_skips_loudly():
+    r = _run([os.path.join(REPO, "tools", "onchip_sweep.py"), "--dryrun",
+              "--budget-s", "0", "--lanes", "r11"])
+    assert r.returncode == 1
+    row = json.loads(r.stdout.splitlines()[0])
+    assert row["skipped"] == "budget exhausted"
+    assert "budget exhausted" in r.stderr
+
+
+def test_sweep_unknown_lane_rejected():
+    r = _run([os.path.join(REPO, "tools", "onchip_sweep.py"), "--dryrun",
+              "--lanes", "r99"])
+    assert r.returncode != 0
+    assert "unknown lane" in (r.stderr + r.stdout)

@@ -1,0 +1,217 @@
+#!/usr/bin/env python3
+"""perfgate — the hardware-free perf-regression gate CLI.
+
+    python tools/perfgate.py --check [--json] [--baseline PATH]
+    python tools/perfgate.py --write-baseline --reason "why" [--lanes a,b]
+    python tools/perfgate.py --snapshot out.json [--lanes a,b]
+    python tools/perfgate.py --lane NAME          # child mode (needs jax)
+    python tools/perfgate.py --list
+
+The parent stays jax-free (the ``telemetry_report`` standalone-load
+trick): each lane runs in a fresh child process with a PINNED platform
+env (``JAX_PLATFORMS=cpu``, ``XLA_FLAGS`` forced to the lane's virtual
+device count, telemetry export knobs stripped) so records cannot be
+skewed by an inherited override — while deliberate regression knobs
+(e.g. ``MXNET_KVSTORE_BUCKET_MB=0``) pass straight through, which is
+exactly how the red-path test injects its dispatch explosion.
+
+Exit codes: 0 pass, 1 drift / lane failure, 2 unusable baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import subprocess
+import sys
+import types
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)     # child mode imports mxnet_tpu itself
+
+
+def _load_perfgate():
+    """Load mxnet_tpu.telemetry.perfgate without running the jax-importing
+    package __init__ (tools/telemetry_report.py precedent)."""
+    if "mxnet_tpu" in sys.modules:
+        return importlib.import_module("mxnet_tpu.telemetry.perfgate")
+    pkg_name = "_telemetry_report_pkg"
+    pkg = sys.modules.get(pkg_name)
+    if pkg is None:
+        pkg = types.ModuleType(pkg_name)
+        pkg.__path__ = [os.path.join(REPO_ROOT, "mxnet_tpu")]
+        sys.modules[pkg_name] = pkg
+    return importlib.import_module(pkg_name + ".telemetry.perfgate")
+
+
+def _child_env(device_count):
+    """The pinned lane environment: deterministic platform, regression
+    knobs passed through."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={device_count}"
+    for k in ("MXNET_TELEMETRY_DIR", "MXNET_TELEMETRY_PORT",
+              "MXNET_PEAK_FLOPS", "MXNET_PEAK_HBM_GBS"):
+        env.pop(k, None)
+    return env
+
+
+def _run_lane_child(pg, name, timeout_s):
+    env = _child_env(pg.lane_device_count(name))
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--lane", name],
+        capture_output=True, text=True, timeout=timeout_s, env=env,
+        cwd=REPO_ROOT)
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+        raise RuntimeError(
+            f"lane {name!r} child failed (rc={proc.returncode}):\n  "
+            + "\n  ".join(tail))
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"lane {name!r} child emitted no JSON record")
+
+
+def _selected_lanes(pg, arg):
+    names = pg.lane_names()
+    sel = arg or os.environ.get("MXNET_PERFGATE_LANES", "")
+    if not sel:
+        return names
+    picked = [s.strip() for s in sel.split(",") if s.strip()]
+    unknown = [p for p in picked if p not in names]
+    if unknown:
+        raise SystemExit(f"unknown lane(s) {unknown}; have {names}")
+    return picked
+
+
+def _snapshot(pg, lanes, timeout_s, quiet=False):
+    records = {}
+    for name in lanes:
+        if not quiet:
+            print(f"perfgate: running lane {name} …", file=sys.stderr)
+        records[name] = _run_lane_child(pg, name, timeout_s)
+    return records
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="analytic perf-regression gate over the cost ledger")
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--check", action="store_true",
+                      help="diff a fresh snapshot against the committed "
+                           "baseline; exit 1 on drift")
+    mode.add_argument("--write-baseline", action="store_true",
+                      help="snapshot and (re)write the baseline file "
+                           "(requires --reason)")
+    mode.add_argument("--snapshot", metavar="PATH",
+                      help="write a fresh snapshot JSON and exit")
+    mode.add_argument("--lane", metavar="NAME",
+                      help="child mode: run ONE lane in-process and print "
+                           "its record (imports jax)")
+    mode.add_argument("--list", action="store_true",
+                      help="list registered lanes")
+    ap.add_argument("--baseline", metavar="PATH",
+                    help="baseline path (default: tests/perf_baseline.json "
+                         "or $MXNET_PERFGATE_BASELINE)")
+    ap.add_argument("--lanes", metavar="A,B",
+                    help="restrict to these lanes "
+                         "(or $MXNET_PERFGATE_LANES)")
+    ap.add_argument("--reason", metavar="TEXT",
+                    help="why the baseline legitimately moved "
+                         "(logged append-only into the file)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the check report as JSON (stdout)")
+    args = ap.parse_args(argv)
+
+    if args.lane:
+        # child mode runs the real runtime: import the genuine package so
+        # the armed ledger/registry are the instances the lane feeds (the
+        # private standalone namespace would arm a parallel copy)
+        importlib.import_module("mxnet_tpu")
+
+    pg = _load_perfgate()
+
+    if args.list:
+        for name in pg.lane_names():
+            fn, devs, desc = pg.LANES[name]
+            print(f"  {name:<24} devices={devs}  {desc}")
+        return 0
+
+    if args.lane:
+        rec = pg.run_lane(args.lane)
+        print(json.dumps(rec, sort_keys=True))
+        return 0
+
+    from_cfg = None
+    try:
+        from_cfg = float(os.environ.get("MXNET_PERFGATE_CHILD_TIMEOUT_S",
+                                        "420"))
+    except ValueError:
+        from_cfg = 420.0
+    timeout_s = from_cfg
+    baseline_path = args.baseline or pg.default_baseline_path()
+    lanes = _selected_lanes(pg, args.lanes)
+
+    if args.snapshot:
+        records = _snapshot(pg, lanes, timeout_s)
+        doc = pg.canonical_doc(records, reasons=[])
+        with open(args.snapshot, "w") as f:
+            f.write(pg.dump_doc(doc))
+        print(f"perfgate snapshot ({len(records)} lanes) -> {args.snapshot}")
+        return 0
+
+    if args.write_baseline:
+        if not args.reason:
+            ap.error("--write-baseline requires --reason "
+                     "(the legitimate-change log is append-only)")
+        reasons = []
+        if os.path.exists(baseline_path):
+            try:
+                reasons = list(
+                    pg.load_baseline(baseline_path).get("reasons") or [])
+            except pg.BaselineError:
+                reasons = []      # corrupt file: start the log over
+        records = _snapshot(pg, lanes, timeout_s)
+        reasons.append({"reason": args.reason, "lanes": sorted(records)})
+        doc = pg.canonical_doc(records, reasons=reasons)
+        os.makedirs(os.path.dirname(os.path.abspath(baseline_path)),
+                    exist_ok=True)
+        with open(baseline_path, "w") as f:
+            f.write(pg.dump_doc(doc))
+        print(f"perfgate baseline ({len(records)} lanes) -> {baseline_path}")
+        return 0
+
+    # --check
+    try:
+        base = pg.load_baseline(baseline_path)
+    except pg.BaselineError as e:
+        print(f"perfgate: {e}", file=sys.stderr)
+        return 2
+    base_lanes = base["lanes"]
+    if args.lanes or os.environ.get("MXNET_PERFGATE_LANES"):
+        base_lanes = {k: v for k, v in base_lanes.items() if k in lanes}
+        lanes = [n for n in lanes if n in base_lanes or n in pg.lane_names()]
+        print(f"perfgate: PARTIAL check over {lanes}", file=sys.stderr)
+    else:
+        # a lane registered in code but absent from the baseline must
+        # surface as "added" — snapshot the full registry
+        lanes = sorted(set(pg.lane_names()) | set(base_lanes))
+        lanes = [n for n in lanes if n in pg.lane_names()]
+    fresh = _snapshot(pg, lanes, timeout_s)
+    report = pg.diff_snapshots(base_lanes, fresh)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        for line in pg.report_lines(report, baseline_path=baseline_path):
+            print(line)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
