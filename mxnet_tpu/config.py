@@ -279,6 +279,12 @@ KNOWN_VARS = {
         "2", int,
         "Worker-pool batch failures DataLoader absorbs via in-process "
         "refetch before permanently degrading to single-process loading."),
+    "MXNET_LOCKCHECK": (
+        "0", int,
+        "If 1, locks created through analysis.tracked() record their "
+        "acquisition order and raise LockOrderError on a cycle — the "
+        "runtime twin of graftcheck GC06 (debug/test builds; disarmed "
+        "locks are returned raw, zero overhead)."),
     "MXNET_CHAOS": (
         "0", int,
         "If 1, arm chaos faults from MXNET_CHAOS_SITES at import "

@@ -32,6 +32,7 @@ import threading
 
 import numpy as _np
 
+from ..analysis.runtime import tracked as _tracked
 from ..base import MXNetError
 
 __all__ = ["SparsePS"]
@@ -42,7 +43,7 @@ class _Table:
 
     def __init__(self, value):
         self.value = value          # numpy (rows, *cols) — host RAM
-        self.lock = threading.Lock()
+        self.lock = _tracked(threading.Lock(), "SparsePS._Table.lock")
         # full-table optimizer state: list of dense numpy arrays (one per
         # state leaf, row-major like value) + per-row inited mask; tree
         # structure is recorded in SparsePS._state_tree
@@ -69,7 +70,7 @@ class SparsePS:
         # under tbl.lock ONLY (pushes to different tables stay concurrent),
         # and restarts if the generation moved in between — a stale
         # updater can never write state past a reset.
-        self._lock = threading.Lock()
+        self._lock = _tracked(threading.Lock(), "SparsePS._lock")
         self._gen = 0
 
     # -- registration -------------------------------------------------------

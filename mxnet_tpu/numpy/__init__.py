@@ -195,6 +195,17 @@ _NP_FUNCS = [
     "astype", "fmod", "isdtype", "poly", "polydiv", "polyfit", "roots",
     "unique_all", "unique_counts", "unique_inverse", "unique_values",
     "unstack",
+    # delegated-surface round 8 (ISSUE 19 satellite): the host-data
+    # constructors (no NDArray inputs — the no-inputs path wraps the
+    # result).  The round's main body is the np.fft subnamespace and the
+    # linalg array-API additions bound in _populate below, plus the
+    # host-returning helpers (array_repr/array_str/einsum_path/
+    # issubdtype/iterable/vectorize) that must NOT route through the
+    # registry delegation (they produce strings/bools/callables, not op
+    # outputs — the jnp.shape precedent).  fromiter is NOT here: jnp
+    # refuses it (consuming an iterator is impure under jit), so it gets
+    # a host-side numpy bind in _populate.
+    "frombuffer", "from_dlpack",
 ]
 
 _self = _sys.modules[__name__]
@@ -304,14 +315,81 @@ def _populate():
 
     block.__doc__ = jnp.block.__doc__
     _self.block = block
+    # round 8 (ISSUE 19 satellite): helpers whose results are strings,
+    # bools, or callables — the registry delegation would try to rebuild
+    # those as op outputs; bind host-side with NDArray unwrapping
+
+    def array_repr(arr, *a, **kw):
+        return jnp.array_repr(_unwrap(arr), *a, **kw)
+
+    array_repr.__doc__ = jnp.array_repr.__doc__
+    _self.array_repr = array_repr
+
+    def array_str(a, *args, **kw):
+        return jnp.array_str(_unwrap(a), *args, **kw)
+
+    array_str.__doc__ = jnp.array_str.__doc__
+    _self.array_str = array_str
+
+    def einsum_path(subscripts, *operands, **kw):
+        return jnp.einsum_path(subscripts, *[_unwrap(o) for o in operands],
+                               **kw)
+
+    einsum_path.__doc__ = jnp.einsum_path.__doc__
+    _self.einsum_path = einsum_path
+
+    def iterable(y):
+        return jnp.iterable(_unwrap(y))
+
+    iterable.__doc__ = jnp.iterable.__doc__
+    _self.iterable = iterable
+    _self.issubdtype = jnp.issubdtype  # pure dtype-lattice logic
+
+    def vectorize(pyfunc, **kw):
+        vf = jnp.vectorize(pyfunc, **kw)
+
+        def vectorized(*args, **kwargs):
+            out = vf(*[_unwrap(a) for a in args], **kwargs)
+            if isinstance(out, tuple):
+                return tuple(_rewrap(o) for o in out)
+            return _rewrap(out)
+
+        vectorized.__doc__ = getattr(pyfunc, "__doc__", None)
+        return vectorized
+
+    vectorize.__doc__ = jnp.vectorize.__doc__
+    _self.vectorize = vectorize
+
+    def fromiter(iterable, dtype, count=-1):
+        # jnp.fromiter raises NotImplementedError (consuming an iterator
+        # is impure under jit) — build on host, then move on-device
+        return _rewrap(jnp.asarray(_onp.fromiter(iterable, dtype=dtype,
+                                                 count=count)))
+
+    fromiter.__doc__ = _onp.fromiter.__doc__
+    _self.fromiter = fromiter
     # subnamespaces
+    # np.fft (round 8) — the whole jnp.fft surface delegates like the
+    # main namespace (complex outputs ride the same versioned NDArray
+    # slot; fftfreq/rfftfreq take no array inputs and wrap host-side)
+    fftm = _types.ModuleType(__name__ + ".fft")
+    import jax.numpy.fft as jfft
+    for name in ("fft", "ifft", "rfft", "irfft", "fft2", "ifft2", "fftn",
+                 "ifftn", "rfft2", "irfft2", "rfftn", "irfftn", "hfft",
+                 "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift"):
+        if hasattr(jfft, name):
+            setattr(fftm, name, _wrap_jnp("fft." + name, getattr(jfft, name)))
+    _sys.modules[fftm.__name__] = fftm
+    _self.fft = fftm
     lin = _types.ModuleType(__name__ + ".linalg")
     import jax.numpy.linalg as jla
     for name in ("norm", "inv", "det", "slogdet", "solve", "lstsq", "pinv",
                  "matrix_rank", "matrix_power", "cholesky", "qr", "svd",
                  "svdvals", "eig", "eigh", "eigvals", "eigvalsh", "cond",
                  "tensorinv", "tensorsolve", "multi_dot", "cross", "outer",
-                 "matmul", "trace", "vector_norm", "matrix_norm"):
+                 "matmul", "trace", "vector_norm", "matrix_norm",
+                 # round 8: the remaining linalg array-API members
+                 "diagonal", "matrix_transpose", "tensordot", "vecdot"):
         if hasattr(jla, name):
             setattr(lin, name, _wrap_jnp("linalg." + name, getattr(jla, name)))
     _sys.modules[lin.__name__] = lin

@@ -31,6 +31,7 @@ import threading
 import time
 import weakref
 
+from ..analysis.runtime import tracked as _tracked
 from ..base import MXNetError
 from .. import config
 from .. import telemetry as _tel
@@ -182,7 +183,7 @@ class Deadline:
         self.timeout_s = timeout_s if timeout_s is not None \
             else config.get_float("MXNET_KVSTORE_TIMEOUT_S", 300.0)
         self.site = site
-        self._lock = threading.Lock()
+        self._lock = _tracked(threading.Lock(), "Deadline._lock")
         self._task_queue = None
         self._worker = None
 

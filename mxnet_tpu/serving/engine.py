@@ -41,6 +41,7 @@ import time
 
 from .. import config
 from .. import telemetry as _tel
+from ..analysis.runtime import tracked as _tracked
 from ..telemetry import tracer as _ttrace
 from ..base import MXNetError
 from ..resilience import Deadline, ResilienceError
@@ -344,7 +345,8 @@ class ServingEngine:
         self._seen_hits = 0
         self._seen_hit_tokens = 0
         self.default_sla_s = config.get_float("MXNET_SERVING_SLA_S", 0.0)
-        self._lock = threading.Lock()      # queue + slots + cache
+        self._lock = _tracked(threading.Lock(),
+                              "ServingEngine._lock")  # queue+slots+cache
         self._queue = collections.deque()
         self._slots = [None] * self.max_batch
         self._tables_dev = None            # device copy of cache.tables

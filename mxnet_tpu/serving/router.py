@@ -82,6 +82,7 @@ import time
 
 from .. import config
 from .. import telemetry as _tel
+from ..analysis.runtime import tracked as _tracked
 from ..telemetry import tracer as _ttrace
 from ..base import MXNetError
 from ..resilience import chaos as _chaos
@@ -254,7 +255,7 @@ class _Replica:
         self.proc = None
         self.pid = None
         self.sock = None
-        self.wlock = threading.Lock()
+        self.wlock = _tracked(threading.Lock(), "Router._Replica.wlock")
         self.state = "down"
         self.load = (0, 0, 0)
         self.last_seen = 0.0
@@ -334,7 +335,7 @@ class Router:
         self._affinity = collections.OrderedDict()  # hash -> replica idx
         self._backoff = Retry(site="router.respawn")
 
-        self._lock = threading.Lock()
+        self._lock = _tracked(threading.Lock(), "Router._lock")
         self._cond = threading.Condition(self._lock)
         self._queue = []                 # _Req waiting for dispatch
         self._requests = {}              # rid -> _Req, every unfinished

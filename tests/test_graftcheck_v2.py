@@ -1,0 +1,741 @@
+"""graftcheck v2 tests (ISSUE 19): the interprocedural rules GC06–GC10
+— trigger + suppress pair per rule, the historical sparse_ps lock-order
+inversion reproduced from a fixture, the lock-order baseline diff (new
+edge = red), the CLI surface (--select/--ignore/--sarif/--stats,
+--write-lock-baseline), the chaos-registry meta-test, and the
+MXNET_LOCKCHECK runtime validator on the real router and the resilience
+Deadline."""
+
+import json
+import os
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis import check_source, check_sources
+from mxnet_tpu.analysis import core as gc_core
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+def _check(src, rel):
+    return check_source(textwrap.dedent(src), rel=rel)
+
+
+# --------------------------------------------------------------------------
+# GC06 — lock-order cycles
+# --------------------------------------------------------------------------
+
+def test_gc06_direct_cycle():
+    findings, _ = _check("""
+        import threading
+
+        _alpha_lock = threading.Lock()
+        _beta_lock = threading.Lock()
+
+        def forward():
+            with _alpha_lock:
+                with _beta_lock:
+                    pass
+
+        def backward():
+            with _beta_lock:
+                with _alpha_lock:
+                    pass
+        """, rel="serving/engine.py")
+    assert _rules(findings) == ["GC06"]
+    msg = findings[0].message
+    # both witness paths are named, not just the cycle's existence
+    assert "forward" in msg and "backward" in msg
+
+
+def test_gc06_interprocedural_cycle_through_calls():
+    """One side of the inversion only materializes two calls deep."""
+    findings, _ = _check("""
+        import threading
+
+        _alpha_lock = threading.Lock()
+        _beta_lock = threading.Lock()
+
+        def _leaf():
+            with _beta_lock:
+                pass
+
+        def _mid():
+            _leaf()
+
+        def forward():
+            with _alpha_lock:
+                _mid()
+
+        def backward():
+            with _beta_lock:
+                with _alpha_lock:
+                    pass
+        """, rel="serving/engine.py")
+    assert _rules(findings) == ["GC06"]
+    assert "_mid" in findings[0].message and "_leaf" in findings[0].message
+
+
+def test_gc06_sparse_ps_inversion_fixture():
+    """The historical bug PR 4 fixed by hand, reverted in a fixture:
+    set_optimizer nests SparsePS._lock -> _Table.lock while push nests
+    the opposite way.  GC06 must reproduce it mechanically."""
+    findings, _ = _check("""
+        import threading
+
+        class _Table:
+            def __init__(self, value):
+                self.value = value
+                self.lock = threading.Lock()
+
+        class SparsePS:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._tables = {}
+                self._updaters = {}
+
+            def set_optimizer(self, opt):
+                with self._lock:
+                    self._updaters.clear()
+                    for tbl in self._tables.values():
+                        with tbl.lock:
+                            tbl.value *= 0
+
+            def push(self, key, grad):
+                tbl = self._tables[key]
+                with tbl.lock:                  # reverted fix: table
+                    with self._lock:            # lock taken FIRST
+                        upd = self._updaters.setdefault(key, object())
+                    tbl.value += grad
+                return upd
+        """, rel="kvstore/sparse_ps.py")
+    assert "GC06" in _rules(findings)
+    msg = [f for f in findings if f.rule == "GC06"][0].message
+    assert "SparsePS._lock" in msg and "_Table.lock" in msg
+    assert "set_optimizer" in msg and "push" in msg
+
+
+def test_gc06_dag_is_clean_and_suppression_works():
+    clean, _ = _check("""
+        import threading
+
+        _alpha_lock = threading.Lock()
+        _beta_lock = threading.Lock()
+
+        def forward():
+            with _alpha_lock:
+                with _beta_lock:
+                    pass
+
+        def also_forward():
+            with _alpha_lock:
+                with _beta_lock:
+                    pass
+        """, rel="serving/engine.py")
+    assert _rules(clean) == []
+    suppressed, kept = _check("""
+        import threading
+
+        _alpha_lock = threading.Lock()
+        _beta_lock = threading.Lock()
+
+        def forward():
+            with _alpha_lock:
+                # graftcheck: ignore[GC06] — fixture: order proven safe
+                with _beta_lock:
+                    pass
+
+        def backward():
+            with _beta_lock:
+                with _alpha_lock:
+                    pass
+        """, rel="serving/engine.py")
+    assert _rules(suppressed) == []
+    assert kept
+
+
+# --------------------------------------------------------------------------
+# GC07 — use-after-donate
+# --------------------------------------------------------------------------
+
+def test_gc07_flags_read_after_donate():
+    findings, _ = _check("""
+        import jax
+
+        def _f(x):
+            return x * 2
+
+        step = jax.jit(_f, donate_argnums=0)
+
+        def run(buf):
+            out = step(buf)
+            total = buf.sum()
+            return out, total
+        """, rel="serving/models.py")
+    assert _rules(findings) == ["GC07"]
+    assert "buf" in findings[0].message
+
+
+def test_gc07_rebinding_over_the_result_is_clean():
+    findings, _ = _check("""
+        import jax
+
+        def _f(x):
+            return x * 2
+
+        step = jax.jit(_f, donate_argnums=0)
+
+        def run(buf):
+            buf = step(buf)
+            return buf.sum()
+        """, rel="serving/models.py")
+    assert _rules(findings) == []
+
+
+def test_gc07_loop_carried_donation():
+    findings, _ = _check("""
+        import jax
+
+        def _f(x):
+            return x * 2
+
+        step = jax.jit(_f, donate_argnums=0)
+
+        def train(buf, n):
+            for _ in range(n):
+                step(buf)
+        """, rel="serving/models.py")
+    assert _rules(findings) == ["GC07"]
+    assert "loop" in findings[0].message
+    clean, _ = _check("""
+        import jax
+
+        def _f(x):
+            return x * 2
+
+        step = jax.jit(_f, donate_argnums=0)
+
+        def train(buf, n):
+            for _ in range(n):
+                buf = step(buf)
+            return buf
+        """, rel="serving/models.py")
+    assert _rules(clean) == []
+
+
+def test_gc07_builder_and_conditional_donation():
+    """Donating jits reach bindings through a builder function and a
+    conditional donate tuple — both still tracked."""
+    findings, _ = _check("""
+        import jax
+
+        def make_step(donate):
+            d = (0,) if donate else ()
+            return jax.jit(lambda x: x * 2, donate_argnums=d)
+
+        def run(v):
+            fn = make_step(True)
+            fn(v)
+            return v + 1
+        """, rel="parallel.py")
+    assert _rules(findings) == ["GC07"]
+
+
+def test_gc07_suppression():
+    findings, kept = _check("""
+        import jax
+
+        def _f(x):
+            return x * 2
+
+        step = jax.jit(_f, donate_argnums=0)
+
+        def run(buf):
+            out = step(buf)
+            # graftcheck: ignore[GC07] — buf is a host mirror, not the donated jax array
+            total = buf.sum()
+            return out, total
+        """, rel="serving/models.py")
+    assert _rules(findings) == []
+    assert kept
+
+
+# --------------------------------------------------------------------------
+# GC08 — atomic-protocol write discipline
+# --------------------------------------------------------------------------
+
+def test_gc08_flags_direct_protocol_write():
+    findings, _ = _check("""
+        import json
+        import os
+
+        def save_state(workdir, state):
+            with open(os.path.join(workdir, "router.json"), "w") as f:
+                json.dump(state, f)
+        """, rel="serving/router.py")
+    assert _rules(findings) == ["GC08"]
+    assert "router.json" in findings[0].message
+
+
+def test_gc08_write_temp_then_replace_is_clean():
+    findings, _ = _check("""
+        import json
+        import os
+
+        def save_state(workdir, state):
+            path = os.path.join(workdir, "controller.json")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, path)
+        """, rel="resilience/controller.py")
+    assert _rules(findings) == []
+
+
+def test_gc08_replace_through_a_helper_is_clean():
+    findings, _ = _check("""
+        import json
+        import os
+
+        def _commit(tmp, path):
+            os.replace(tmp, path)
+
+        def beat(workdir, rank, state):
+            path = os.path.join(workdir, f"hb-rank{rank:05d}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            _commit(tmp, path)
+        """, rel="resilience/heartbeat.py")
+    assert _rules(findings) == []
+
+
+def test_gc08_append_only_logs_and_reads_exempt():
+    findings, _ = _check("""
+        def log_cancel(workdir, rid):
+            with open(workdir + "/cancels-replica-0001.log", "a") as f:
+                f.write(rid)
+
+        def read_state(workdir):
+            with open(workdir + "/router.json") as f:
+                return f.read()
+        """, rel="serving/replica.py")
+    assert _rules(findings) == []
+
+
+def test_gc08_suppression():
+    findings, kept = _check("""
+        import json
+
+        def save_state(path, state):
+            # graftcheck: ignore[GC08] — single-process test harness, no concurrent reader
+            with open(path + "/manifest.json", "w") as f:
+                json.dump(state, f)
+        """, rel="checkpoint.py")
+    assert _rules(findings) == []
+    assert kept
+
+
+# --------------------------------------------------------------------------
+# GC09 — registry drift
+# --------------------------------------------------------------------------
+
+_CHAOS_FIXTURE = """
+SITES = ("kvstore.allreduce", "router.dispatch")
+
+def hit(site):
+    return None
+"""
+
+
+def test_gc09_unregistered_chaos_site():
+    findings, _ = check_sources({
+        "resilience/chaos.py": _CHAOS_FIXTURE,
+        "serving/router.py": textwrap.dedent("""
+            from ..resilience import chaos
+
+            def dispatch():
+                chaos.hit("router.dispatch")
+                chaos.hit("router.dispach")
+            """),
+    })
+    assert _rules(findings) == ["GC09"]
+    assert "router.dispach" in findings[0].message
+
+
+def test_gc09_non_literal_site_flagged():
+    findings, _ = check_sources({
+        "resilience/chaos.py": _CHAOS_FIXTURE,
+        "serving/router.py": textwrap.dedent("""
+            from ..resilience import chaos
+
+            def dispatch(site):
+                chaos.hit(site)
+            """),
+    })
+    assert _rules(findings) == ["GC09"]
+    assert "non-literal" in findings[0].message
+
+
+def test_gc09_metric_name_conventions():
+    findings, _ = _check("""
+        def register(reg):
+            reg.counter("mxnet_foo")
+            reg.histogram("mxnet_bar_ms")
+            reg.gauge("mxnet_baz_total")
+            reg.counter("mxnet_Bad_name_total")
+            reg.counter("mxnet_ok_total")
+            reg.histogram("mxnet_ok_seconds")
+            reg.gauge("mxnet_ok_depth")
+        """, rel="telemetry/extras.py")
+    assert _rules(findings) == ["GC09"] * 4
+
+
+def test_gc09_suppression():
+    findings, kept = _check("""
+        def register(reg):
+            # graftcheck: ignore[GC09] — legacy dashboard name, migration tracked
+            reg.counter("mxnet_foo")
+        """, rel="telemetry/extras.py")
+    assert _rules(findings) == []
+    assert kept
+
+
+def test_every_chaos_site_is_armed_by_a_test():
+    """Meta-test backing the GC09 registry contract: each committed
+    chaos site is referenced by at least one test in this directory."""
+    from mxnet_tpu.resilience import chaos
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    blob = "\n".join(
+        open(os.path.join(tests_dir, fn), encoding="utf-8").read()
+        for fn in sorted(os.listdir(tests_dir)) if fn.endswith(".py"))
+    assert chaos.SITES, "the chaos registry must not be empty"
+    for site in chaos.SITES:
+        assert site in blob, f"chaos site {site!r} is armed by no test"
+
+
+# --------------------------------------------------------------------------
+# GC10 — thread lifecycle
+# --------------------------------------------------------------------------
+
+def test_gc10_nondaemon_unjoined_thread():
+    findings, _ = _check("""
+        import threading
+
+        class Worker:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                return None
+        """, rel="serving/engine.py")
+    assert _rules(findings) == ["GC10"]
+    assert "daemon" in findings[0].message
+
+
+def test_gc10_daemon_or_joined_is_clean():
+    findings, _ = _check("""
+        import threading
+
+        class Worker:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+                self._w = threading.Thread(target=self._run)
+                self._w.start()
+
+            def close(self):
+                self._w.join()
+
+            def _run(self):
+                return None
+        """, rel="serving/engine.py")
+    assert _rules(findings) == []
+
+
+def test_gc10_unstoppable_while_true():
+    findings, _ = _check("""
+        import threading
+
+        class Worker:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                while True:
+                    self._work()
+
+            def _work(self):
+                return None
+        """, rel="serving/engine.py")
+    assert _rules(findings) == ["GC10"]
+    assert "while True" in findings[0].message
+
+
+def test_gc10_stop_flag_or_sentinel_return_is_clean():
+    findings, _ = _check("""
+        import threading
+
+        class Worker:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+                self._s = threading.Thread(target=self._sender, daemon=True)
+                self._s.start()
+
+            def _run(self):
+                while True:
+                    if self._stop:
+                        break
+                    self._work()
+
+            def _sender(self):
+                while True:
+                    item = self._q.get()
+                    if item is None:
+                        return
+                    self._work()
+
+            def _work(self):
+                return None
+        """, rel="serving/engine.py")
+    assert _rules(findings) == []
+
+
+def test_gc10_while_true_reached_through_calls():
+    """The loop lives in a helper the thread target calls — still
+    reachable, still checked."""
+    findings, _ = _check("""
+        import threading
+
+        class Worker:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                self._pump()
+
+            def _pump(self):
+                while True:
+                    self._work()
+
+            def _work(self):
+                return None
+        """, rel="serving/engine.py")
+    assert _rules(findings) == ["GC10"]
+
+
+def test_gc10_suppression():
+    findings, kept = _check("""
+        import threading
+
+        class Worker:
+            def start(self):
+                # graftcheck: ignore[GC10] — process-lifetime supervisor, reaped by atexit
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                return None
+        """, rel="serving/engine.py")
+    assert _rules(findings) == []
+    assert kept
+
+
+# --------------------------------------------------------------------------
+# CLI: --select / --ignore / --sarif / --stats / lock baseline
+# --------------------------------------------------------------------------
+
+_DIRTY = "import os\nv = os.environ.get('MXNET_ROGUE')\n"
+
+
+def _mk_pkg(tmp_path, files):
+    pkg = tmp_path / "mxnet_tpu"
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(pkg)
+
+
+def test_cli_select_and_ignore(tmp_path):
+    pkg = _mk_pkg(tmp_path, {"bad.py": _DIRTY})
+    root = str(tmp_path)
+    assert gc_core.main([pkg, "-q"], repo_root=root) == 1
+    assert gc_core.main([pkg, "-q", "--select", "GC06,GC07"],
+                        repo_root=root) == 0
+    assert gc_core.main([pkg, "-q", "--ignore", "GC03"],
+                        repo_root=root) == 0
+    assert gc_core.main([pkg, "-q", "--select", "GC03"],
+                        repo_root=root) == 1
+
+
+def test_cli_sarif_output(tmp_path):
+    pkg = _mk_pkg(tmp_path, {"bad.py": _DIRTY})
+    out = tmp_path / "out.sarif"
+    assert gc_core.main([pkg, "-q", "--sarif", str(out)],
+                        repo_root=str(tmp_path)) == 1
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"GC00", "GC01", "GC06", "GC07", "GC08", "GC09",
+            "GC10"} <= rule_ids
+    res = run["results"]
+    assert res and res[0]["ruleId"] == "GC03"
+    loc = res[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert res[0]["partialFingerprints"]["graftcheck/v1"]
+
+
+def test_cli_stats_table(tmp_path, capsys):
+    pkg = _mk_pkg(tmp_path, {"ok.py": "X = 1\n"})
+    assert gc_core.main([pkg, "-q", "--stats"],
+                        repo_root=str(tmp_path)) == 0
+    err = capsys.readouterr().err
+    for rule in ("GC01", "GC06", "GC10"):
+        assert rule in err
+
+
+_NESTED = """
+import threading
+
+_alpha_lock = threading.Lock()
+_beta_lock = threading.Lock()
+_gamma_lock = threading.Lock()
+
+def forward():
+    with _alpha_lock:
+        with _beta_lock:
+            pass
+"""
+
+
+def test_cli_lock_baseline_diff(tmp_path):
+    """The CI contract: a new lock-order edge not in the committed
+    baseline is a loud failure; a stale baseline edge too."""
+    pkg = _mk_pkg(tmp_path, {"serving/engine.py": _NESTED})
+    root = str(tmp_path)
+    base = tmp_path / "graftcheck-lockorder.json"
+    assert gc_core.main([pkg, "-q", "--write-lock-baseline", str(base)],
+                        repo_root=root) == 0
+    edges = json.loads(base.read_text())["edges"]
+    assert [(e["from"], e["to"]) for e in edges] == \
+        [("serving/engine.py::_alpha_lock", "serving/engine.py::_beta_lock")]
+    # observed set matches the baseline -> clean
+    assert gc_core.main([pkg, "-q"], repo_root=root) == 1 - 1
+    # inject a NEW (acyclic) edge -> red until the baseline is regenerated
+    _mk_pkg(tmp_path, {"serving/engine.py": _NESTED + textwrap.dedent("""
+        def deeper():
+            with _beta_lock:
+                with _gamma_lock:
+                    pass
+        """)})
+    assert gc_core.main([pkg, "-q"], repo_root=root) == 1
+    assert gc_core.main([pkg, "-q", "--write-lock-baseline", str(base)],
+                        repo_root=root) == 0
+    assert gc_core.main([pkg, "-q"], repo_root=root) == 0
+    # remove the nesting -> the baseline edge is stale -> red again
+    _mk_pkg(tmp_path, {"serving/engine.py": "X = 1\n"})
+    assert gc_core.main([pkg, "-q"], repo_root=root) == 1
+
+
+def test_repo_lock_baseline_is_current():
+    """The committed graftcheck-lockorder.json matches the tree (the
+    same invariant the CI lane enforces)."""
+    base = os.path.join(REPO_ROOT, "graftcheck-lockorder.json")
+    assert os.path.exists(base), "commit the lock-order baseline"
+    pkg = os.path.join(REPO_ROOT, "mxnet_tpu")
+    findings, _, _ = analysis.analyze_paths([pkg], repo_root=REPO_ROOT)
+    gc06 = [f for f in findings if f.rule == "GC06"]
+    assert gc06 == [], "\n".join(f.render() for f in gc06)
+
+
+# --------------------------------------------------------------------------
+# MXNET_LOCKCHECK — the GC06 runtime twin
+# --------------------------------------------------------------------------
+
+@pytest.fixture
+def lockcheck():
+    analysis.arm_lockcheck(True)
+    analysis.lockcheck_reset()
+    yield
+    analysis.arm_lockcheck(None)
+    analysis.lockcheck_reset()
+
+
+def test_lockcheck_disarmed_returns_raw_lock():
+    lk = threading.Lock()
+    assert analysis.tracked(lk, "raw") is lk
+
+
+def test_lockcheck_raises_on_inversion(lockcheck):
+    a = analysis.tracked(threading.Lock(), "A")
+    b = analysis.tracked(threading.Lock(), "B")
+    with a:
+        with b:
+            pass
+    with pytest.raises(analysis.LockOrderError) as ei:
+        with b:
+            with a:
+                pass
+    assert "A" in str(ei.value) and "B" in str(ei.value)
+    assert ("A", "B") in analysis.lockcheck_edges()
+
+
+def test_lockcheck_transitive_cycle(lockcheck):
+    a = analysis.tracked(threading.Lock(), "A")
+    b = analysis.tracked(threading.Lock(), "B")
+    c = analysis.tracked(threading.Lock(), "C")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(analysis.LockOrderError):
+        with c:
+            with a:
+                pass
+
+
+def test_lockcheck_router(lockcheck, tmp_path):
+    """The router's locks flow through tracked(): a real tier bring-up +
+    request records Router acquisition edges and raises nothing."""
+    from mxnet_tpu.serving.router import Router
+    stub = [sys.executable,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "_stub_replica.py")]
+    r = Router(stub, 1, str(tmp_path),
+               env_extra={"MXNET_ELASTIC_HEARTBEAT_S": "0.1"}).start()
+    try:
+        h = r.submit([1, 2, 3], max_new_tokens=4)
+        assert len(h.result(timeout=30)) == 4
+    finally:
+        r.stop()
+    held_first = {a for a, _ in analysis.lockcheck_edges()}
+    assert any(name.startswith("Router.") for name in held_first), \
+        "expected the router to record tracked acquisition edges"
+
+
+def test_lockcheck_controller_deadline(lockcheck):
+    """The resilience tier's Deadline lock is tracked: a guarded call
+    under the armed validator runs clean (and the lock really is the
+    validating proxy, not a bare Lock)."""
+    from mxnet_tpu.analysis.runtime import _TrackedLock
+    from mxnet_tpu.resilience import Deadline
+    d = Deadline(timeout_s=5, site="lockcheck.unit")
+    assert isinstance(d._lock, _TrackedLock)
+    assert d.call(lambda: "ok") == "ok"
+    d.close()

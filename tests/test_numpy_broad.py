@@ -1256,3 +1256,128 @@ def test_np_extended_surface_round7(case):
     else:
         onp.testing.assert_allclose(onp.asarray(got, want.dtype), want,
                                     rtol=2e-5, atol=2e-6)
+
+
+# -- round 8 (ISSUE 19): the np.fft subnamespace, the remaining linalg
+# array-API members (diagonal/matrix_transpose/tensordot/vecdot), the
+# host-data constructors (frombuffer/fromiter), vectorize, and the
+# host-returning helpers (array_repr/array_str/einsum_path/issubdtype/
+# iterable).  Dotted names resolve through subnamespaces; fft cases
+# compare magnitudes so the complex64-vs-complex128 width difference
+# stays inside the float tolerance.
+
+def _np_attr(m, dotted):
+    for part in dotted.split("."):
+        if not hasattr(m, part):
+            return None
+        m = getattr(m, part)
+    return m
+
+
+EXT_FNS8 = [
+    ("fft.fft", lambda m, x: m.abs(m.fft.fft(m.array(x), axis=1)),
+     lambda x: onp.abs(onp.fft.fft(x, axis=1))),
+    ("fft.ifft", lambda m, x: m.abs(m.fft.ifft(m.array(x), axis=1)),
+     lambda x: onp.abs(onp.fft.ifft(x, axis=1))),
+    ("fft.rfft", lambda m, x: m.abs(m.fft.rfft(m.array(x), axis=1)),
+     lambda x: onp.abs(onp.fft.rfft(x, axis=1))),
+    ("fft.irfft", lambda m, x: m.fft.irfft(m.array(x), axis=1),
+     lambda x: onp.fft.irfft(x, axis=1)),
+    ("fft.fft2", lambda m, x: m.abs(m.fft.fft2(m.array(x))),
+     lambda x: onp.abs(onp.fft.fft2(x))),
+    ("fft.ifft2", lambda m, x: m.abs(m.fft.ifft2(m.array(x))),
+     lambda x: onp.abs(onp.fft.ifft2(x))),
+    ("fft.fftn", lambda m, x: m.abs(m.fft.fftn(m.array(x))),
+     lambda x: onp.abs(onp.fft.fftn(x))),
+    ("fft.ifftn", lambda m, x: m.abs(m.fft.ifftn(m.array(x))),
+     lambda x: onp.abs(onp.fft.ifftn(x))),
+    ("fft.rfft2", lambda m, x: m.abs(m.fft.rfft2(m.array(x))),
+     lambda x: onp.abs(onp.fft.rfft2(x))),
+    ("fft.irfft2", lambda m, x: m.fft.irfft2(m.array(x)),
+     lambda x: onp.fft.irfft2(x)),
+    ("fft.rfftn", lambda m, x: m.abs(m.fft.rfftn(m.array(x))),
+     lambda x: onp.abs(onp.fft.rfftn(x))),
+    ("fft.irfftn", lambda m, x: m.fft.irfftn(m.array(x)),
+     lambda x: onp.fft.irfftn(x)),
+    ("fft.hfft", lambda m, x: m.fft.hfft(m.array(x), axis=1),
+     lambda x: onp.fft.hfft(x, axis=1)),
+    ("fft.ihfft", lambda m, x: m.abs(m.fft.ihfft(m.array(x), axis=1)),
+     lambda x: onp.abs(onp.fft.ihfft(x, axis=1))),
+    ("fft.fftfreq", lambda m, x: m.fft.fftfreq(8, d=0.5),
+     lambda x: onp.fft.fftfreq(8, d=0.5)),
+    ("fft.rfftfreq", lambda m, x: m.fft.rfftfreq(8, d=0.5),
+     lambda x: onp.fft.rfftfreq(8, d=0.5)),
+    ("fft.fftshift", lambda m, x: m.fft.fftshift(m.array(x), axes=1),
+     lambda x: onp.fft.fftshift(x, axes=1)),
+    ("fft.ifftshift", lambda m, x: m.fft.ifftshift(m.array(x), axes=1),
+     lambda x: onp.fft.ifftshift(x, axes=1)),
+    ("linalg.diagonal",
+     lambda m, x: m.linalg.diagonal(m.array(x[:4, :4])),
+     lambda x: onp.linalg.diagonal(x[:4, :4])),
+    ("linalg.matrix_transpose",
+     lambda m, x: m.linalg.matrix_transpose(m.array(x)),
+     lambda x: x.T),
+    ("linalg.tensordot",
+     lambda m, x: m.linalg.tensordot(m.array(x), m.array(x.T), axes=1),
+     lambda x: onp.tensordot(x, x.T, axes=1)),
+    ("linalg.vecdot",
+     lambda m, x: m.linalg.vecdot(m.array(x), m.array(x + 1.0)),
+     lambda x: onp.einsum("ij,ij->i", x, x + 1.0)),
+    ("frombuffer",
+     lambda m, x: m.frombuffer(x.tobytes(), dtype="float32"),
+     lambda x: onp.frombuffer(x.tobytes(), dtype=onp.float32)),
+    ("fromiter",
+     lambda m, x: m.fromiter((float(i) for i in range(6)),
+                             dtype="float32", count=6),
+     lambda x: onp.fromiter((float(i) for i in range(6)),
+                            dtype=onp.float32, count=6)),
+    ("vectorize",
+     lambda m, x: m.vectorize(lambda a, b: a * b + 1.0)(
+         m.array(x), m.array(x)),
+     lambda x: x * x + 1.0),
+]
+
+
+@pytest.mark.parametrize("case", EXT_FNS8, ids=[c[0] for c in EXT_FNS8])
+def test_np_extended_surface_round8(case):
+    name, mx_fn, onp_fn = case
+    if _np_attr(np, name) is None:
+        pytest.skip(f"mx.np.{name} absent")
+    x = _r((4, 5), 81)
+    got = mx_fn(np, x)
+    got = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    want = onp.asarray(onp_fn(x))
+    assert got.shape == want.shape, \
+        f"{name}: shape {got.shape} vs numpy {want.shape}"
+    if want.dtype.kind == "b":
+        assert onp.dtype(got.dtype).kind == "b", \
+            f"{name}: bool result came back as {got.dtype}"
+        onp.testing.assert_array_equal(got, want)
+    elif want.dtype.kind in "iu":
+        assert onp.dtype(got.dtype).kind in "iu", \
+            f"{name}: integer result came back as {got.dtype}"
+        onp.testing.assert_array_equal(got, want)
+    else:
+        onp.testing.assert_allclose(onp.asarray(got, want.dtype), want,
+                                    rtol=2e-4, atol=2e-5)
+
+
+def test_np_round8_host_helpers():
+    """The string/bool-returning helpers stay host-side: they take an
+    NDArray and hand back plain python values, never op outputs."""
+    a = np.array(onp.arange(4.0, dtype=onp.float32))
+    r = np.array_repr(a)
+    s = np.array_str(a)
+    assert isinstance(r, str) and "3." in r
+    assert isinstance(s, str) and "3." in s
+    assert np.iterable(a) is True
+    assert np.iterable(3.0) is False
+    assert np.issubdtype(onp.float32, onp.floating)
+    assert not np.issubdtype(onp.int32, onp.floating)
+    # (jnp's path omits numpy's "einsum_path" header element and hands
+    # back opt_einsum's PathInfo object where numpy prints a string — the
+    # contraction report lives in its str())
+    path, info = np.einsum_path("ij,jk->ik", a.reshape(2, 2),
+                                a.reshape(2, 2))
+    assert isinstance(path, list)
+    assert "Complete contraction" in str(info)
